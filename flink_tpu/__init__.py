@@ -1,0 +1,29 @@
+"""flink-tpu: a TPU-native stateful stream-processing framework.
+
+A from-scratch re-architecture of Apache Flink's capabilities (see SURVEY.md)
+for JAX/XLA/Pallas: keyed state lives on-device as sharded arrays partitioned
+by key-group range, records flow as columnar micro-batches, window triggers
+fire one compiled segment-reduce over all keys in a subtask's range, and
+multi-chip scale-out uses `jax.sharding` meshes with XLA collectives over ICI
+instead of point-to-point TCP shuffles.
+
+Layer map (mirrors SURVEY.md §1):
+  core/      L0  config, types/records, key groups, watermarks, serde
+  api/       L5  DataStream API
+  graph/     L5  Transformation DAG -> StreamGraph -> JobGraph (chaining)
+  runtime/   L4  step-loop tasks, operators, timers, harness
+  state/     L3  state backend SPI: host hashmap + device-resident TPU backend
+  window/    L4  assigners/triggers/slice-shared panes
+  checkpoint/L2  barriers, coordinator, snapshots, restore/rescale
+  parallel/  --  mesh & sharding utilities (ICI collectives)
+  ops/       --  XLA/Pallas kernels (segment-reduce, device hash table)
+  cluster/   L2  scheduler, minicluster, failover, heartbeats
+  sql/       L6  SQL/Table layer compiled to the same stage graph
+  metrics/   L9  metric groups + reporters + spans
+  cep/       L8  pattern matching
+  connectors/L8  sources/sinks
+"""
+
+__version__ = "0.1.0"
+
+from .core import *  # noqa: F401,F403

@@ -1,0 +1,35 @@
+"""Core substrate: config, key groups, records, functions, watermarks, serde.
+
+Maps the reference's L0 layer (flink-core): see SURVEY.md §2.1.
+"""
+
+from .config import (  # noqa: F401
+    CheckpointingOptions, ConfigOption, Configuration, MetricOptions,
+    PipelineOptions, RuntimeOptions, StateOptions, all_options, key,
+    parse_duration, parse_memory_size,
+)
+from .elements import (  # noqa: F401
+    MAX_WATERMARK, CheckpointBarrier, EndOfInput, LatencyMarker, Watermark,
+    WatermarkStatus,
+)
+from .functions import (  # noqa: F401
+    AggregateFunction, BuiltinAggregate, Collector, FilterFunction,
+    FlatMapFunction, Function, KeySelector, KeyedProcessFunction, MapFunction,
+    ProcessFunction, ReduceAggregate, ReduceFunction, RuntimeContext,
+    SinkFunction, SourceFunction, as_filter, as_flat_map, as_key_selector,
+    as_map, as_reduce,
+)
+from .keygroups import (  # noqa: F401
+    DEFAULT_MAX_PARALLELISM, KeyGroupRange, assign_to_key_group,
+    compute_default_max_parallelism, hash_batch, key_group_for_hash,
+    key_group_range_for_operator, key_groups_for_hash_batch, murmur_mix,
+    operator_index_for_key_group, stable_hash,
+)
+from .records import (  # noqa: F401
+    MAX_TIMESTAMP, MIN_TIMESTAMP, FieldType, RecordBatch, Schema,
+)
+from .serializers import (  # noqa: F401
+    PickleSerializer, Serializer, SerializerSnapshot, deserialize_batch,
+    registry, serialize_batch,
+)
+from .watermarks import WatermarkGenerator, WatermarkStrategy  # noqa: F401

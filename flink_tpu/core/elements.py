@@ -1,0 +1,82 @@
+"""Stream elements: what flows through a channel besides record batches.
+
+Analog of the reference's StreamElement hierarchy
+(flink-streaming-java runtime/streamrecord/: StreamRecord, Watermark,
+WatermarkStatus, LatencyMarker) plus the checkpoint barrier
+(flink-runtime io/network/api/CheckpointBarrier). Here the record case is a
+whole RecordBatch (see core/records.py); control elements are tiny frozen
+dataclasses interleaved with batches in channel order — ordering is what gives
+barriers/watermarks their alignment semantics.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .records import MAX_TIMESTAMP, RecordBatch
+
+__all__ = [
+    "Watermark", "WatermarkStatus", "CheckpointBarrier", "LatencyMarker",
+    "EndOfInput", "StreamElement", "MAX_WATERMARK",
+]
+
+
+@dataclass(frozen=True)
+class Watermark:
+    """Event-time watermark: no further records with ts <= this will arrive."""
+
+    timestamp: int
+
+    def __le__(self, other: "Watermark") -> bool:
+        return self.timestamp <= other.timestamp
+
+
+MAX_WATERMARK = Watermark(MAX_TIMESTAMP)
+
+
+@dataclass(frozen=True)
+class WatermarkStatus:
+    """Channel idleness marker (reference watermarkstatus/WatermarkStatus)."""
+
+    active: bool
+
+    @classmethod
+    def idle(cls) -> "WatermarkStatus":
+        return cls(False)
+
+    @classmethod
+    def active_(cls) -> "WatermarkStatus":
+        return cls(True)
+
+
+@dataclass(frozen=True)
+class CheckpointBarrier:
+    """Checkpoint barrier (reference CheckpointBarrier): all state mutations
+    from batches before the barrier belong to checkpoint ``checkpoint_id``."""
+
+    checkpoint_id: int
+    timestamp: float = field(default_factory=time.time)
+    # options mirror CheckpointOptions: savepoint flag + unaligned capability
+    is_savepoint: bool = False
+    unaligned: bool = False
+
+
+@dataclass(frozen=True)
+class LatencyMarker:
+    """End-to-end latency probe injected at sources."""
+
+    marked_time: float
+    source_id: str
+    subtask: int
+
+
+@dataclass(frozen=True)
+class EndOfInput:
+    """Graceful end-of-stream for bounded inputs (reference EndOfData)."""
+
+
+# A channel carries: RecordBatch | Watermark | WatermarkStatus |
+#                    CheckpointBarrier | LatencyMarker | EndOfInput
+StreamElement = Any

@@ -1,0 +1,290 @@
+"""User function APIs.
+
+Analog of flink-core's function contracts
+(api/common/functions/: MapFunction, FlatMapFunction, FilterFunction,
+ReduceFunction, AggregateFunction.java:114) plus the process-function family
+(flink-streaming-java api/functions/KeyedProcessFunction). Two deliberate
+departures for the TPU architecture:
+
+* Functions may declare a **vectorized** path (``*_batch`` methods over numpy
+  columns, or a pure jax-traceable expression) in addition to the per-row
+  path; the runtime uses the vectorized path when present and falls back to a
+  row loop otherwise. Built-in aggregates (sum/count/min/max/avg...) lower all
+  the way to device segment-reduce kernels (ops/segment_ops.py).
+* ``open``/``close`` lifecycle mirrors RichFunction; RuntimeContext exposes
+  subtask info, metrics, and keyed state accessors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
+
+import numpy as np
+
+IN = TypeVar("IN")
+OUT = TypeVar("OUT")
+ACC = TypeVar("ACC")
+KEY = TypeVar("KEY")
+
+
+class RuntimeContext:
+    """What a rich function sees at runtime (reference RuntimeContext)."""
+
+    def __init__(self, task_name: str, subtask_index: int, parallelism: int,
+                 max_parallelism: int, metrics=None, state_backend=None,
+                 attempt_number: int = 0):
+        self.task_name = task_name
+        self.subtask_index = subtask_index
+        self.parallelism = parallelism
+        self.max_parallelism = max_parallelism
+        self.metrics = metrics
+        self.attempt_number = attempt_number
+        self._state_backend = state_backend
+
+    # Keyed state accessors — valid only inside keyed operators; the current
+    # key is managed by the enclosing operator (see runtime/operators/keyed.py).
+    def get_state(self, descriptor) -> Any:
+        if self._state_backend is None:
+            raise RuntimeError("Keyed state is only available in keyed operators")
+        return self._state_backend.get_partitioned_state(descriptor)
+
+
+class Function:
+    """Base lifecycle (reference RichFunction.open/close)."""
+
+    def open(self, ctx: RuntimeContext) -> None:  # pragma: no cover - trivial
+        pass
+
+    def close(self) -> None:  # pragma: no cover - trivial
+        pass
+
+
+class MapFunction(Function, Generic[IN, OUT]):
+    def map(self, value: IN) -> OUT:
+        raise NotImplementedError
+
+    def map_batch(self, batch) -> Optional[Any]:
+        """Optional vectorized path: RecordBatch -> RecordBatch, or None to
+        use the per-row loop."""
+        return None
+
+
+class FlatMapFunction(Function, Generic[IN, OUT]):
+    def flat_map(self, value: IN) -> Iterable[OUT]:
+        raise NotImplementedError
+
+
+class FilterFunction(Function, Generic[IN]):
+    def filter(self, value: IN) -> bool:
+        raise NotImplementedError
+
+    def filter_batch(self, batch) -> Optional[np.ndarray]:
+        """Optional vectorized path: RecordBatch -> bool mask, or None."""
+        return None
+
+
+class ReduceFunction(Function, Generic[IN]):
+    """Commutative-associative pairwise combine (reference ReduceFunction)."""
+
+    def reduce(self, a: IN, b: IN) -> IN:
+        raise NotImplementedError
+
+
+class AggregateFunction(Function, Generic[IN, ACC, OUT]):
+    """Incremental aggregation contract — the exact add/merge/get_result
+    semantics of the reference's AggregateFunction.java:114, which the device
+    segment-reduce kernels must honor (add folds a record into an accumulator;
+    merge folds two accumulators; both must agree)."""
+
+    def create_accumulator(self) -> ACC:
+        raise NotImplementedError
+
+    def add(self, value: IN, accumulator: ACC) -> ACC:
+        raise NotImplementedError
+
+    def get_result(self, accumulator: ACC) -> OUT:
+        raise NotImplementedError
+
+    def merge(self, a: ACC, b: ACC) -> ACC:
+        raise NotImplementedError
+
+
+class KeySelector(Function, Generic[IN, KEY]):
+    def get_key(self, value: IN) -> KEY:
+        raise NotImplementedError
+
+
+@dataclass
+class Collector(Generic[OUT]):
+    """Push-style output (reference util/Collector)."""
+
+    _sink: Callable[[OUT, Optional[int]], None]
+
+    def collect(self, value: OUT, timestamp: Optional[int] = None) -> None:
+        self._sink(value, timestamp)
+
+
+class ProcessFunction(Function, Generic[IN, OUT]):
+    """Low-level per-record access with timers + side outputs
+    (reference KeyedProcessFunction)."""
+
+    class Context:
+        def __init__(self, timestamp, timer_service, current_key=None,
+                     side_collector=None):
+            self.timestamp = timestamp
+            self.timer_service = timer_service
+            self.current_key = current_key
+            self._side = side_collector
+
+        def output(self, tag: str, value: Any,
+                   timestamp: Optional[int] = None) -> None:
+            if self._side is None:
+                raise RuntimeError("side outputs not wired")
+            self._side(tag, value, timestamp)
+
+    class OnTimerContext(Context):
+        def __init__(self, timestamp, timer_service, time_domain, current_key,
+                     side_collector=None):
+            super().__init__(timestamp, timer_service, current_key, side_collector)
+            self.time_domain = time_domain  # "event" | "processing"
+
+    def process_element(self, value: IN, ctx: "ProcessFunction.Context",
+                        out: Collector[OUT]) -> None:
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int, ctx: "ProcessFunction.OnTimerContext",
+                 out: Collector[OUT]) -> None:
+        pass
+
+
+KeyedProcessFunction = ProcessFunction  # alias; keyed-ness comes from the stream
+
+
+class SourceFunction(Function, Generic[OUT]):
+    """Legacy-style run/cancel source; prefer connectors (FLIP-27 analog)."""
+
+    def run(self, emit: Callable[[OUT, Optional[int]], None]) -> None:
+        raise NotImplementedError
+
+    def cancel(self) -> None:
+        pass
+
+
+class SinkFunction(Function, Generic[IN]):
+    def invoke(self, value: IN, timestamp: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def invoke_batch(self, batch) -> bool:
+        """Optional vectorized path; return True if the batch was consumed."""
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Lambda adapters — the DataStream API accepts plain callables.
+# ---------------------------------------------------------------------------
+
+class _LambdaMap(MapFunction):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def map(self, value):
+        return self._fn(value)
+
+
+class _LambdaFlatMap(FlatMapFunction):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def flat_map(self, value):
+        return self._fn(value)
+
+
+class _LambdaFilter(FilterFunction):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def filter(self, value):
+        return self._fn(value)
+
+
+class _LambdaReduce(ReduceFunction):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def reduce(self, a, b):
+        return self._fn(a, b)
+
+
+class _LambdaKeySelector(KeySelector):
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def get_key(self, value):
+        return self._fn(value)
+
+
+def as_map(f) -> MapFunction:
+    return f if isinstance(f, MapFunction) else _LambdaMap(f)
+
+
+def as_flat_map(f) -> FlatMapFunction:
+    return f if isinstance(f, FlatMapFunction) else _LambdaFlatMap(f)
+
+
+def as_filter(f) -> FilterFunction:
+    return f if isinstance(f, FilterFunction) else _LambdaFilter(f)
+
+
+def as_reduce(f) -> ReduceFunction:
+    return f if isinstance(f, ReduceFunction) else _LambdaReduce(f)
+
+
+def as_key_selector(f) -> KeySelector:
+    return f if isinstance(f, KeySelector) else _LambdaKeySelector(f)
+
+
+# ---------------------------------------------------------------------------
+# Built-in aggregates with device lowerings.
+#
+# ``BuiltinAggregate`` names a reduction the device backend knows how to run
+# as a segment-reduce (ops/segment_ops.py); the host path uses the same
+# add/merge contract via numpy ufuncs.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BuiltinAggregate:
+    kind: str            # sum | count | min | max | avg
+    field: Optional[str]  # input column; None for count
+
+    @property
+    def accumulator_fields(self) -> tuple[str, ...]:
+        if self.kind == "avg":
+            return ("sum", "count")
+        return (self.kind,)
+
+
+class ReduceAggregate(AggregateFunction):
+    """Wraps a ReduceFunction into the AggregateFunction contract
+    (reference's internal ReducingState behaves the same way)."""
+
+    _EMPTY = object()
+
+    def __init__(self, reduce_fn: ReduceFunction):
+        self._reduce = reduce_fn
+
+    def create_accumulator(self):
+        return self._EMPTY
+
+    def add(self, value, acc):
+        return value if acc is self._EMPTY else self._reduce.reduce(acc, value)
+
+    def merge(self, a, b):
+        if a is self._EMPTY:
+            return b
+        if b is self._EMPTY:
+            return a
+        return self._reduce.reduce(a, b)
+
+    def get_result(self, acc):
+        return None if acc is self._EMPTY else acc

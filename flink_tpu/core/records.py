@@ -1,0 +1,238 @@
+"""Columnar record batches: the unit of data flowing between operators.
+
+TPU-native analog of the reference's row representation
+(flink-table-common BinaryRowData.java:62 — binary row over MemorySegments) and of
+per-record StreamRecords (flink-streaming-java runtime/streamrecord/): instead of one
+object per record, records travel in fixed-size struct-of-arrays micro-batches whose
+numeric columns can be shipped to the device as one transfer and processed by one
+compiled step. Python-object payloads are supported for host-side operators via
+object-dtype columns.
+
+Every batch carries per-record event timestamps (int64 millis, like the reference's
+StreamRecord timestamp) so event-time operators don't need a side channel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Mapping, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Schema", "FieldType", "RecordBatch", "MIN_TIMESTAMP", "MAX_TIMESTAMP"]
+
+MIN_TIMESTAMP = -(1 << 62)
+MAX_TIMESTAMP = (1 << 62) - 1
+
+# Canonical dtype aliases accepted in schemas.
+_DTYPES = {
+    "int32": np.int32, "int64": np.int64, "float32": np.float32,
+    "float64": np.float64, "bool": np.bool_, "uint32": np.uint32,
+    "object": object, "str": object, "bytes": object,
+}
+
+
+@dataclass(frozen=True)
+class FieldType:
+    name: str
+    dtype: Any  # numpy dtype or `object`
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.dtype is not object
+
+
+class Schema:
+    """Ordered, named, typed fields of a stream (reference RowType analog)."""
+
+    def __init__(self, fields: Sequence[tuple[str, Any]]):
+        self.fields: tuple[FieldType, ...] = tuple(
+            FieldType(n, _DTYPES.get(d, d) if isinstance(d, str) else d)
+            for n, d in fields)
+        self._index = {f.name: i for i, f in enumerate(self.fields)}
+        if len(self._index) != len(self.fields):
+            raise ValueError("Duplicate field names in schema")
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.fields)
+
+    def field(self, name: str) -> FieldType:
+        return self.fields[self._index[name]]
+
+    def index_of(self, name: str) -> int:
+        return self._index[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Schema) and self.fields == other.fields
+
+    def __repr__(self) -> str:
+        return "Schema(" + ", ".join(f"{f.name}:{getattr(f.dtype, '__name__', f.dtype)}"
+                                     for f in self.fields) + ")"
+
+    def numeric_names(self) -> tuple[str, ...]:
+        return tuple(f.name for f in self.fields if f.is_numeric)
+
+    @staticmethod
+    def of(**fields: Any) -> "Schema":
+        return Schema(list(fields.items()))
+
+    @staticmethod
+    def infer(row: Any) -> "Schema":
+        """Infer a schema from one sample element.
+
+        Scalars become single-column ('value',) schemas; tuples become f0..fN
+        (like the reference's TypeExtractor for tuples).
+        """
+        def dtype_of(v: Any) -> Any:
+            if isinstance(v, (bool, np.bool_)):
+                return np.bool_
+            if isinstance(v, (int, np.integer)):
+                return np.int64
+            if isinstance(v, (float, np.floating)):
+                return np.float64
+            return object
+
+        if isinstance(row, tuple):
+            return Schema([(f"f{i}", dtype_of(v)) for i, v in enumerate(row)])
+        if isinstance(row, dict):
+            return Schema([(k, dtype_of(v)) for k, v in row.items()])
+        return Schema([("value", dtype_of(row))])
+
+
+class RecordBatch:
+    """A micro-batch of records: struct-of-arrays + per-record timestamps.
+
+    Columns are dense numpy arrays of equal length ``n``. There is no validity
+    mask at this level — host operators slice/compact eagerly; the device path
+    pads to a static shape and carries its own mask (see ops/device_batch.py).
+    """
+
+    __slots__ = ("schema", "columns", "timestamps", "n")
+
+    def __init__(self, schema: Schema, columns: Mapping[str, np.ndarray],
+                 timestamps: Optional[np.ndarray] = None):
+        self.schema = schema
+        self.columns: dict[str, np.ndarray] = {}
+        n = None
+        for f in schema.fields:
+            col = np.asarray(columns[f.name],
+                             dtype=f.dtype if f.is_numeric else object)
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(f"Column {f.name} length {len(col)} != {n}")
+            self.columns[f.name] = col
+        self.n = n or 0
+        if timestamps is None:
+            timestamps = np.full(self.n, MIN_TIMESTAMP, dtype=np.int64)
+        self.timestamps = np.asarray(timestamps, dtype=np.int64)
+        if len(self.timestamps) != self.n:
+            raise ValueError("timestamps length mismatch")
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_rows(cls, schema: Schema, rows: Sequence[Any],
+                  timestamps: Optional[Sequence[int]] = None) -> "RecordBatch":
+        """Build from Python rows (scalars / tuples / dicts per the schema)."""
+        n = len(rows)
+        cols: dict[str, list] = {f.name: [None] * n for f in schema.fields}
+        single = len(schema) == 1
+        for i, row in enumerate(rows):
+            if isinstance(row, dict):
+                for f in schema.fields:
+                    cols[f.name][i] = row[f.name]
+            elif isinstance(row, tuple) and not single:
+                for f, v in zip(schema.fields, row):
+                    cols[f.name][i] = v
+            else:
+                cols[schema.fields[0].name][i] = row
+        arrs = {
+            f.name: np.array(cols[f.name],
+                             dtype=f.dtype if f.is_numeric else object)
+            for f in schema.fields
+        }
+        ts = None if timestamps is None else np.asarray(timestamps, dtype=np.int64)
+        return cls(schema, arrs, ts)
+
+    @classmethod
+    def empty(cls, schema: Schema) -> "RecordBatch":
+        cols = {f.name: np.empty(0, dtype=f.dtype if f.is_numeric else object)
+                for f in schema.fields}
+        return cls(schema, cols, np.empty(0, dtype=np.int64))
+
+    @classmethod
+    def concat(cls, batches: Sequence["RecordBatch"]) -> "RecordBatch":
+        if not batches:
+            raise ValueError("concat of zero batches")
+        if len(batches) == 1:
+            return batches[0]
+        schema = batches[0].schema
+        cols = {name: np.concatenate([b.columns[name] for b in batches])
+                for name in schema.names}
+        ts = np.concatenate([b.timestamps for b in batches])
+        return cls(schema, cols, ts)
+
+    # -- accessors ---------------------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def row(self, i: int) -> Any:
+        """Materialize row i as a scalar (1-col schema) or tuple."""
+        if len(self.schema) == 1:
+            v = self.columns[self.schema.fields[0].name][i]
+            return v.item() if isinstance(v, np.generic) else v
+        return tuple(
+            v.item() if isinstance(v := self.columns[f.name][i], np.generic) else v
+            for f in self.schema.fields)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for i in range(self.n):
+            yield self.row(i)
+
+    def to_pylist(self) -> list:
+        return list(self.iter_rows())
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __repr__(self) -> str:
+        return f"RecordBatch(n={self.n}, schema={self.schema!r})"
+
+    # -- transforms (all return new batches; arrays are shared not copied) --
+    def with_columns(self, schema: Schema,
+                     columns: Mapping[str, np.ndarray]) -> "RecordBatch":
+        return RecordBatch(schema, columns, self.timestamps)
+
+    def with_timestamps(self, ts: np.ndarray) -> "RecordBatch":
+        return RecordBatch(self.schema, self.columns, ts)
+
+    def take(self, indices: np.ndarray) -> "RecordBatch":
+        cols = {n: c[indices] for n, c in self.columns.items()}
+        return RecordBatch(self.schema, cols, self.timestamps[indices])
+
+    def filter(self, mask: np.ndarray) -> "RecordBatch":
+        return self.take(np.flatnonzero(mask))
+
+    def slice(self, start: int, stop: int) -> "RecordBatch":
+        cols = {n: c[start:stop] for n, c in self.columns.items()}
+        return RecordBatch(self.schema, cols, self.timestamps[start:stop])
+
+    def select(self, names: Sequence[str]) -> "RecordBatch":
+        schema = Schema([(n, self.schema.field(n).dtype) for n in names])
+        return RecordBatch(schema, {n: self.columns[n] for n in names},
+                           self.timestamps)
+
+    def split_by(self, part: np.ndarray, num_parts: int) -> list["RecordBatch"]:
+        """Partition rows by an int partition-id array (stable within parts)."""
+        order = np.argsort(part, kind="stable")
+        sorted_part = part[order]
+        bounds = np.searchsorted(sorted_part, np.arange(num_parts + 1))
+        return [self.take(order[bounds[p]:bounds[p + 1]])
+                for p in range(num_parts)]

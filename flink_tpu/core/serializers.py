@@ -1,0 +1,132 @@
+"""Serializer registry for state snapshots and inter-host exchange.
+
+Analog of the reference's type/serialization stack (flink-core
+api/common/typeutils/TypeSerializer.java:60, TypeSerializerSnapshot): binary
+serde with versioned snapshots so restored state can detect schema changes.
+Device-bound data never goes through this path — columnar batches move as raw
+numpy buffers (serialize_batch) and device arrays via DMA; this registry covers
+control-plane payloads, host state, and object columns.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .records import RecordBatch, Schema
+
+__all__ = [
+    "Serializer", "PickleSerializer", "serialize_batch", "deserialize_batch",
+    "SerializerSnapshot", "registry",
+]
+
+_MAGIC = b"FTB1"  # flink-tpu batch format v1
+
+
+class Serializer:
+    name = "base"
+    version = 1
+
+    def serialize(self, obj: Any) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    def snapshot(self) -> "SerializerSnapshot":
+        return SerializerSnapshot(self.name, self.version)
+
+
+@dataclass(frozen=True)
+class SerializerSnapshot:
+    """Versioned serializer identity written next to state
+    (reference TypeSerializerSnapshot) — restore checks compatibility."""
+
+    name: str
+    version: int
+
+    def is_compatible(self, current: Serializer) -> bool:
+        return self.name == current.name and self.version <= current.version
+
+
+class PickleSerializer(Serializer):
+    """Default serializer (the KryoSerializer-fallback analog)."""
+
+    name = "pickle"
+
+    def serialize(self, obj: Any) -> bytes:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, data: bytes) -> Any:
+        return pickle.loads(data)
+
+
+class _Registry:
+    def __init__(self):
+        self._by_name: dict[str, Serializer] = {}
+        self.register(PickleSerializer())
+
+    def register(self, serializer: Serializer) -> None:
+        self._by_name[serializer.name] = serializer
+
+    def get(self, name: str) -> Serializer:
+        return self._by_name[name]
+
+    def default(self) -> Serializer:
+        return self._by_name["pickle"]
+
+
+registry = _Registry()
+
+
+def serialize_batch(batch: RecordBatch) -> bytes:
+    """Columnar wire format: numeric columns as raw little-endian buffers,
+    object columns pickled. Self-describing header carries the schema."""
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    header = {
+        "n": batch.n,
+        "fields": [(f.name, "object" if not f.is_numeric else np.dtype(f.dtype).str)
+                   for f in batch.schema.fields],
+    }
+    hbytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+    buf.write(struct.pack("<I", len(hbytes)))
+    buf.write(hbytes)
+    buf.write(batch.timestamps.astype("<i8").tobytes())
+    for f in batch.schema.fields:
+        col = batch.columns[f.name]
+        if f.is_numeric:
+            buf.write(col.astype(np.dtype(f.dtype).newbyteorder("<")).tobytes())
+        else:
+            payload = pickle.dumps(col.tolist(), protocol=pickle.HIGHEST_PROTOCOL)
+            buf.write(struct.pack("<I", len(payload)))
+            buf.write(payload)
+    return buf.getvalue()
+
+
+def deserialize_batch(data: bytes) -> RecordBatch:
+    buf = io.BytesIO(data)
+    if buf.read(4) != _MAGIC:
+        raise ValueError("Bad batch magic")
+    (hlen,) = struct.unpack("<I", buf.read(4))
+    header = pickle.loads(buf.read(hlen))
+    n = header["n"]
+    ts = np.frombuffer(buf.read(8 * n), dtype="<i8").astype(np.int64)
+    cols: dict[str, np.ndarray] = {}
+    fields = []
+    for name, dtype_str in header["fields"]:
+        if dtype_str == "object":
+            (plen,) = struct.unpack("<I", buf.read(4))
+            cols[name] = np.array(pickle.loads(buf.read(plen)), dtype=object)
+            fields.append((name, object))
+        else:
+            dt = np.dtype(dtype_str)
+            cols[name] = np.frombuffer(buf.read(dt.itemsize * n), dtype=dt) \
+                .astype(dt.newbyteorder("="))
+            fields.append((name, dt.type))
+    return RecordBatch(Schema(fields), cols, ts)

@@ -1,0 +1,117 @@
+"""Event-time watermark strategies.
+
+Analog of flink-core's eventtime package
+(api/common/eventtime/: WatermarkStrategy, BoundedOutOfOrdernessWatermarks,
+WatermarksWithIdleness, WatermarkAlignmentParams). Generators here are
+batch-oriented: they observe whole RecordBatches (vectorized max) instead of
+per-record callbacks, and emit on micro-batch boundaries — the periodic-emit
+cadence of the reference maps onto the step loop's batch cadence.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .records import MIN_TIMESTAMP, RecordBatch
+
+__all__ = ["WatermarkStrategy", "WatermarkGenerator", "TimestampAssigner"]
+
+
+TimestampAssigner = Callable[[Any, int], int]  # (element, record_ts) -> event ts ms
+
+
+class WatermarkGenerator:
+    """Stateful per-source-split generator."""
+
+    def on_batch(self, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def current_watermark(self) -> int:
+        raise NotImplementedError
+
+
+class _BoundedOutOfOrderness(WatermarkGenerator):
+    """max seen ts - delay - 1, matching BoundedOutOfOrdernessWatermarks."""
+
+    def __init__(self, max_out_of_orderness_ms: int):
+        self._delay = int(max_out_of_orderness_ms)
+        self._max_ts = MIN_TIMESTAMP + self._delay + 1
+
+    def on_batch(self, batch: RecordBatch) -> None:
+        if batch.n:
+            self._max_ts = max(self._max_ts, int(batch.timestamps.max()))
+
+    def current_watermark(self) -> int:
+        return self._max_ts - self._delay - 1
+
+
+class _NoWatermarks(WatermarkGenerator):
+    def on_batch(self, batch: RecordBatch) -> None:
+        pass
+
+    def current_watermark(self) -> int:
+        return MIN_TIMESTAMP
+
+
+@dataclass(frozen=True)
+class WatermarkStrategy:
+    """Factory for generators + timestamp assignment + idleness config."""
+
+    _gen_factory: Callable[[], WatermarkGenerator]
+    timestamp_assigner: Optional[TimestampAssigner] = None
+    timestamp_column: Optional[str] = None
+    idle_timeout: Optional[float] = None  # seconds of silence -> idle
+    alignment_group: Optional[str] = None
+    alignment_max_drift_ms: int = 0
+
+    # -- factories ---------------------------------------------------------
+    @staticmethod
+    def for_bounded_out_of_orderness(max_out_of_orderness_ms: int) -> "WatermarkStrategy":
+        return WatermarkStrategy(
+            lambda: _BoundedOutOfOrderness(max_out_of_orderness_ms))
+
+    @staticmethod
+    def for_monotonous_timestamps() -> "WatermarkStrategy":
+        return WatermarkStrategy(lambda: _BoundedOutOfOrderness(0))
+
+    @staticmethod
+    def no_watermarks() -> "WatermarkStrategy":
+        return WatermarkStrategy(lambda: _NoWatermarks())
+
+    # -- builders ----------------------------------------------------------
+    def with_timestamp_assigner(self, fn: TimestampAssigner) -> "WatermarkStrategy":
+        return replace(self, timestamp_assigner=fn, timestamp_column=None)
+
+    def with_timestamp_column(self, column: str) -> "WatermarkStrategy":
+        """Vectorized assignment: event time = this int64 column (ms)."""
+        return replace(self, timestamp_column=column, timestamp_assigner=None)
+
+    def with_idleness(self, timeout_seconds: float) -> "WatermarkStrategy":
+        return replace(self, idle_timeout=timeout_seconds)
+
+    def with_watermark_alignment(self, group: str,
+                                 max_drift_ms: int) -> "WatermarkStrategy":
+        """Source watermark alignment (reference WatermarkAlignmentParams):
+        sources in the same group pause when ahead of min+drift."""
+        return replace(self, alignment_group=group,
+                       alignment_max_drift_ms=max_drift_ms)
+
+    # -- runtime use -------------------------------------------------------
+    def create_generator(self) -> WatermarkGenerator:
+        return self._gen_factory()
+
+    def assign_timestamps(self, batch: RecordBatch) -> RecordBatch:
+        if self.timestamp_column is not None:
+            return batch.with_timestamps(
+                batch.column(self.timestamp_column).astype(np.int64))
+        if self.timestamp_assigner is not None:
+            ts = np.fromiter(
+                (self.timestamp_assigner(row, int(batch.timestamps[i]))
+                 for i, row in enumerate(batch.iter_rows())),
+                dtype=np.int64, count=batch.n)
+            return batch.with_timestamps(ts)
+        return batch

@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU platform so multi-chip sharding
+paths run without TPU hardware (the MiniCluster-analog of the reference's
+single-JVM multi-TaskExecutor testing, SURVEY.md §4 tier 3)."""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def eight_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+    devs = np.array(jax.devices("cpu")[:8])
+    with Mesh(devs, ("data",)) as m:
+        yield m

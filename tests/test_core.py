@@ -1,0 +1,165 @@
+"""Core substrate tests: config, key groups, records, watermarks, serde."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core import (
+    Configuration, PipelineOptions, CheckpointingOptions, KeyGroupRange,
+    RecordBatch, Schema, WatermarkStrategy, assign_to_key_group,
+    deserialize_batch, hash_batch, key_group_for_hash,
+    key_group_range_for_operator, key_groups_for_hash_batch, murmur_mix,
+    operator_index_for_key_group, serialize_batch, stable_hash,
+)
+from flink_tpu.core.config import key, parse_duration, parse_memory_size
+
+
+class TestConfig:
+    def test_typed_get_set(self):
+        c = Configuration()
+        assert c.get(PipelineOptions.BATCH_SIZE) == 4096
+        c.set(PipelineOptions.BATCH_SIZE, 128)
+        assert c.get(PipelineOptions.BATCH_SIZE) == 128
+
+    def test_string_values_parsed(self):
+        c = Configuration({"pipeline.micro-batch-size": "512",
+                           "execution.checkpointing.interval": "500ms",
+                           "pipeline.operator-chaining": "false"})
+        assert c.get(PipelineOptions.BATCH_SIZE) == 512
+        assert c.get(CheckpointingOptions.INTERVAL) == 0.5
+        assert c.get(PipelineOptions.CHAINING_ENABLED) is False
+
+    def test_duration_memory_parsing(self):
+        assert parse_duration("250ms") == 0.25
+        assert parse_duration("2 min") == 120.0
+        assert parse_duration(3) == 3.0
+        assert parse_memory_size("32kb") == 32768
+        assert parse_memory_size("1g") == 1024 ** 3
+
+    def test_fallback_keys(self):
+        opt = key("test.new-key").int_type().with_fallback_keys(
+            "test.old-key").default_value(7)
+        c = Configuration({"test.old-key": 42})
+        assert c.get(opt) == 42
+
+    def test_merge_and_json_roundtrip(self):
+        a = Configuration({"x": 1})
+        b = a.merge({"x": 2, "y": 3})
+        assert a.get_raw("x") == 1 and b.get_raw("x") == 2
+        c = Configuration.from_json(b.to_json())
+        assert c == b
+
+
+class TestKeyGroups:
+    def test_stable_hash_deterministic(self):
+        assert stable_hash("hello") == stable_hash("hello")
+        assert stable_hash(42) == 42
+        assert stable_hash((1, "a")) == stable_hash((1, "a"))
+
+    def test_murmur_spread_nonnegative(self):
+        vals = murmur_mix(np.arange(10000, dtype=np.uint32))
+        assert (vals >= 0).all()
+        # spread: all groups hit for 10k hashes over 128 groups
+        groups = vals % 128
+        assert len(np.unique(groups)) == 128
+
+    def test_assignment_in_range(self):
+        for k in ["a", "b", 1, 2, (3, "x")]:
+            kg = assign_to_key_group(k, 128)
+            assert 0 <= kg < 128
+
+    def test_ranges_partition_exactly(self):
+        # every key group owned by exactly one operator, ranges contiguous
+        for maxp, p in [(128, 1), (128, 4), (128, 3), (4096, 7), (128, 128)]:
+            owned = []
+            for i in range(p):
+                r = key_group_range_for_operator(maxp, p, i)
+                owned.extend(list(r))
+                for kg in r:
+                    assert operator_index_for_key_group(maxp, p, kg) == i
+            assert sorted(owned) == list(range(maxp))
+
+    def test_vectorized_matches_scalar(self):
+        keys = np.arange(1000, dtype=np.int64)
+        hashes = hash_batch(keys)
+        groups = key_groups_for_hash_batch(hashes, 128)
+        for i in [0, 17, 999]:
+            assert groups[i] == key_group_for_hash(int(hashes[i]), 128)
+
+    def test_rescaling_stability(self):
+        """Key->group mapping is parallelism-independent: rescaling only
+        moves whole groups (the property checkpoint re-sharding relies on)."""
+        keys = [f"key-{i}" for i in range(500)]
+        g1 = [assign_to_key_group(k, 128) for k in keys]
+        g2 = [assign_to_key_group(k, 128) for k in keys]
+        assert g1 == g2
+
+    def test_range_intersect(self):
+        a, b = KeyGroupRange(0, 63), KeyGroupRange(32, 100)
+        assert a.intersect(b) == KeyGroupRange(32, 63)
+        assert a.intersect(KeyGroupRange(100, 120)).is_empty()
+
+
+class TestRecordBatch:
+    def test_from_rows_tuple_schema(self):
+        s = Schema([("word", object), ("count", np.int64)])
+        b = RecordBatch.from_rows(s, [("a", 1), ("b", 2)], [10, 20])
+        assert b.n == 2
+        assert b.to_pylist() == [("a", 1), ("b", 2)]
+        assert list(b.timestamps) == [10, 20]
+
+    def test_scalar_schema(self):
+        s = Schema([("value", np.int64)])
+        b = RecordBatch.from_rows(s, [1, 2, 3])
+        assert b.to_pylist() == [1, 2, 3]
+
+    def test_filter_take_slice_concat(self):
+        s = Schema([("v", np.int64)])
+        b = RecordBatch.from_rows(s, list(range(10)), list(range(10)))
+        f = b.filter(b.column("v") % 2 == 0)
+        assert f.to_pylist() == [0, 2, 4, 6, 8]
+        assert b.slice(2, 5).to_pylist() == [2, 3, 4]
+        c = RecordBatch.concat([b.slice(0, 2), b.slice(8, 10)])
+        assert c.to_pylist() == [0, 1, 8, 9]
+        assert list(c.timestamps) == [0, 1, 8, 9]
+
+    def test_split_by_partition(self):
+        s = Schema([("v", np.int64)])
+        b = RecordBatch.from_rows(s, list(range(8)))
+        parts = b.split_by(np.array([0, 1, 0, 1, 2, 2, 0, 1]), 3)
+        assert parts[0].to_pylist() == [0, 2, 6]
+        assert parts[1].to_pylist() == [1, 3, 7]
+        assert parts[2].to_pylist() == [4, 5]
+
+    def test_schema_infer(self):
+        s = Schema.infer(("a", 1, 2.0))
+        assert s.names == ("f0", "f1", "f2")
+        assert not s.field("f0").is_numeric
+        assert s.field("f1").dtype is np.int64
+
+    def test_serde_roundtrip(self):
+        s = Schema([("word", object), ("n", np.int64), ("x", np.float32)])
+        b = RecordBatch.from_rows(
+            s, [("a", 1, 0.5), ("bb", 2, 1.5)], [100, 200])
+        rb = deserialize_batch(serialize_batch(b))
+        assert rb.to_pylist() == [("a", 1, 0.5), ("bb", 2, 1.5)]
+        assert list(rb.timestamps) == [100, 200]
+
+
+class TestWatermarks:
+    def test_bounded_out_of_orderness(self):
+        ws = WatermarkStrategy.for_bounded_out_of_orderness(100)
+        gen = ws.create_generator()
+        s = Schema([("v", np.int64)])
+        gen.on_batch(RecordBatch.from_rows(s, [1, 2], [1000, 2000]))
+        assert gen.current_watermark() == 2000 - 100 - 1
+        # watermark never regresses on older data
+        gen.on_batch(RecordBatch.from_rows(s, [3], [1500]))
+        assert gen.current_watermark() == 1899
+
+    def test_timestamp_column_assignment(self):
+        ws = WatermarkStrategy.for_monotonous_timestamps() \
+            .with_timestamp_column("ts")
+        s = Schema([("ts", np.int64), ("v", np.int64)])
+        b = RecordBatch.from_rows(s, [(5, 0), (9, 1)])
+        b2 = ws.assign_timestamps(b)
+        assert list(b2.timestamps) == [5, 9]
