@@ -1,0 +1,7 @@
+"""DataStream API layer (SURVEY.md §2.5)."""
+
+from .datastream import (  # noqa: F401
+    ConnectedStreams, DataStream, KeyedStream, WindowedStream,
+    make_key_extractor,
+)
+from .environment import StreamExecutionEnvironment  # noqa: F401

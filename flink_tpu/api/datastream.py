@@ -1,0 +1,496 @@
+"""DataStream API: the fluent stream-building surface.
+
+Analog of flink-streaming-java's DataStream family
+(api/datastream/DataStream.java — map:591, keyBy:291, transform:1178;
+KeyedStream, WindowedStream, ConnectedStreams, side outputs). Builds a lazy
+Transformation DAG; ``StreamExecutionEnvironment.execute`` compiles and runs
+it.
+
+Key selectors may be a column name (vectorized hashing — preferred) or a
+row callable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.functions import (
+    AggregateFunction, BuiltinAggregate, ProcessFunction, SinkFunction,
+    as_filter, as_flat_map, as_map, as_reduce,
+)
+from ..core.records import RecordBatch, Schema
+from ..graph.transformations import (
+    OneInputTransformation, PartitionTransformation, SideOutputTransformation,
+    SinkTransformation, SourceTransformation, Transformation,
+    TwoInputTransformation, UnionTransformation,
+)
+from ..window.assigners import (
+    EventTimeSessionWindows, GlobalWindows, SlidingEventTimeWindows,
+    TumblingEventTimeWindows, WindowAssigner,
+)
+from ..window.triggers import CountTrigger, Evictor, PurgingTrigger, Trigger
+
+__all__ = ["DataStream", "KeyedStream", "WindowedStream", "ConnectedStreams",
+           "make_key_extractor"]
+
+KeySpec = Union[str, Callable[[Any], Any]]
+
+
+def make_key_extractor(key: KeySpec):
+    """RecordBatch -> np.ndarray of per-row keys."""
+    if isinstance(key, str):
+        def extract_col(batch: RecordBatch) -> np.ndarray:
+            return batch.column(key)
+        extract_col.column = key  # vectorizable marker
+        return extract_col
+
+    fn = key
+
+    def extract_fn(batch: RecordBatch) -> np.ndarray:
+        return np.array([fn(r) for r in batch.iter_rows()], dtype=object)
+    return extract_fn
+
+
+class DataStream:
+    def __init__(self, env, transformation: Transformation):
+        self.env = env
+        self.transformation = transformation
+
+    # -- basic transforms --------------------------------------------------
+    def _one_input(self, name: str, factory, parallelism=None,
+                   key_extractor=None, schema=None, traceable=False,
+                   chaining_allowed=True) -> "DataStream":
+        t = OneInputTransformation(
+            name=name, operator_factory=factory,
+            parallelism=parallelism,
+            schema=schema, inputs=[self.transformation],
+            key_extractor=key_extractor, traceable=traceable,
+            chaining_allowed=chaining_allowed)
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)
+
+    def map(self, fn, name: str = "Map", out_schema: Optional[Schema] = None,
+            parallelism: Optional[int] = None) -> "DataStream":
+        mf = as_map(fn)
+        from ..runtime.operators.simple import MapOperator
+        return self._one_input(
+            name, lambda: MapOperator(mf, out_schema, name), parallelism)
+
+    def flat_map(self, fn, name: str = "FlatMap",
+                 out_schema: Optional[Schema] = None,
+                 parallelism: Optional[int] = None) -> "DataStream":
+        ff = as_flat_map(fn)
+        from ..runtime.operators.simple import FlatMapOperator
+        return self._one_input(
+            name, lambda: FlatMapOperator(ff, out_schema, name), parallelism)
+
+    def filter(self, fn, name: str = "Filter",
+               parallelism: Optional[int] = None) -> "DataStream":
+        pf = as_filter(fn)
+        from ..runtime.operators.simple import FilterOperator
+        return self._one_input(name, lambda: FilterOperator(pf, name),
+                               parallelism)
+
+    def transform(self, name: str, operator_factory,
+                  parallelism: Optional[int] = None,
+                  traceable: bool = False) -> "DataStream":
+        """Escape hatch: attach a custom operator (reference transform:1178)."""
+        return self._one_input(name, operator_factory, parallelism,
+                               traceable=traceable)
+
+    def process(self, fn: ProcessFunction, name: str = "Process",
+                parallelism: Optional[int] = None) -> "DataStream":
+        """Non-keyed process function (no keyed state access)."""
+        from ..runtime.operators.simple import KeyedProcessOperator
+
+        def extract(batch: RecordBatch) -> np.ndarray:
+            return np.zeros(batch.n, dtype=np.int64)  # single pseudo-key
+
+        return self._one_input(name, lambda: KeyedProcessOperator(fn, extract,
+                                                                  name=name),
+                               parallelism)
+
+    # -- keying / partitioning --------------------------------------------
+    def key_by(self, key: KeySpec) -> "KeyedStream":
+        from ..runtime.writer import KeyGroupPartitioner
+        extractor = make_key_extractor(key)
+        maxp = self.env.max_parallelism
+        t = PartitionTransformation(
+            name="keyed-exchange",
+            partitioner_factory=lambda: KeyGroupPartitioner(extractor, maxp),
+            partitioner_name="hash",
+            inputs=[self.transformation])
+        self.env._transformations.append(t)
+        return KeyedStream(self.env, t, extractor, key)
+
+    def _repartition(self, name: str, factory) -> "DataStream":
+        t = PartitionTransformation(
+            name=name, partitioner_factory=factory, partitioner_name=name,
+            inputs=[self.transformation])
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)
+
+    def rebalance(self) -> "DataStream":
+        from ..runtime.writer import RebalancePartitioner
+        return self._repartition("rebalance", RebalancePartitioner)
+
+    def rescale(self) -> "DataStream":
+        from ..runtime.writer import RescalePartitioner
+        return self._repartition("rescale", RescalePartitioner)
+
+    def broadcast(self) -> "DataStream":
+        from ..runtime.writer import BroadcastPartitioner
+        return self._repartition("broadcast", BroadcastPartitioner)
+
+    def shuffle(self) -> "DataStream":
+        from ..runtime.writer import ShufflePartitioner
+        return self._repartition("shuffle", ShufflePartitioner)
+
+    def global_(self) -> "DataStream":
+        from ..runtime.writer import GlobalPartitioner
+        return self._repartition("global", GlobalPartitioner)
+
+    def forward(self) -> "DataStream":
+        from ..runtime.writer import ForwardPartitioner
+        return self._repartition("forward", ForwardPartitioner)
+
+    def partition_custom(self, fn: Callable[[Any, int], int],
+                         key: KeySpec) -> "DataStream":
+        from ..runtime.writer import CustomPartitioner
+        extractor = make_key_extractor(key)
+        return self._repartition(
+            "custom", lambda: CustomPartitioner(fn, extractor))
+
+    # -- unions / connect --------------------------------------------------
+    def union(self, *others: "DataStream") -> "DataStream":
+        t = UnionTransformation(
+            name="union",
+            inputs=[self.transformation] + [o.transformation for o in others])
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)
+
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        return ConnectedStreams(self.env, self, other)
+
+    # -- side outputs ------------------------------------------------------
+    def get_side_output(self, tag: str) -> "DataStream":
+        t = SideOutputTransformation(name=f"side-{tag}", tag=tag,
+                                     inputs=[self.transformation])
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)
+
+    # -- windows (non-keyed) ----------------------------------------------
+    def window_all(self, assigner: WindowAssigner) -> "WindowedStream":
+        """All-windows: single pseudo-key, parallelism forced to 1."""
+        keyed = self.global_().key_by(lambda _row: 0)
+        return WindowedStream(keyed, assigner, all_windows=True)
+
+    # -- sinks -------------------------------------------------------------
+    def add_sink(self, sink, name: str = "Sink",
+                 parallelism: Optional[int] = None) -> "DataStream":
+        from ..connectors.core import Sink
+        from ..runtime.operators.sink import FunctionSinkOperator, SinkOperator
+        if isinstance(sink, Sink):
+            factory = lambda: SinkOperator(sink, name)  # noqa: E731
+        elif isinstance(sink, SinkFunction):
+            factory = lambda: FunctionSinkOperator(sink, name)  # noqa: E731
+        else:
+            raise TypeError("add_sink expects a Sink or SinkFunction")
+        t = SinkTransformation(name=name, operator_factory=factory,
+                               parallelism=parallelism,
+                               inputs=[self.transformation])
+        self.env._transformations.append(t)
+        self.env._sinks.append(t)
+        return self
+
+    def sink_to(self, sink, name: str = "Sink",
+                parallelism: Optional[int] = None) -> "DataStream":
+        return self.add_sink(sink, name, parallelism)
+
+    def print(self, prefix: str = "") -> "DataStream":
+        from ..connectors.core import PrintSink
+        return self.add_sink(PrintSink(prefix), "Print")
+
+    def execute_and_collect(self, job_name: str = "collect") -> list:
+        from ..connectors.core import CollectSink
+        sink = CollectSink()
+        self.add_sink(sink, "Collect")
+        self.env.execute(job_name)
+        return sink.rows
+
+    # -- misc --------------------------------------------------------------
+    def set_parallelism(self, parallelism: int) -> "DataStream":
+        self.transformation.parallelism = parallelism
+        return self
+
+    def uid(self, uid: str) -> "DataStream":
+        self.transformation.uid = uid
+        return self
+
+    def name(self, name: str) -> "DataStream":
+        self.transformation.name = name
+        return self
+
+    def disable_chaining(self) -> "DataStream":
+        self.transformation.chaining_allowed = False
+        return self
+
+    def slot_sharing_group(self, group: str) -> "DataStream":
+        self.transformation.slot_sharing_group = group
+        return self
+
+    def assign_timestamps_and_watermarks(self, ws) -> "DataStream":
+        """Mid-stream watermark assignment (reference
+        assignTimestampsAndWatermarks)."""
+        from ..runtime.operators.simple import BatchFnOperator
+        from ..core.elements import Watermark
+        from ..runtime.operators.base import OneInputOperator
+
+        class _WmOperator(OneInputOperator):
+            def __init__(self):
+                super().__init__("TimestampsWatermarks")
+                self._gen = ws.create_generator()
+
+            def process_batch(self, batch):
+                batch = ws.assign_timestamps(batch)
+                self._gen.on_batch(batch)
+                self.output.emit(batch)
+                wm = self._gen.current_watermark()
+                if wm > self.current_watermark:
+                    self.current_watermark = wm
+                    self.output.emit_watermark(Watermark(wm))
+
+            def process_watermark(self, watermark):
+                pass  # replaced by generated watermarks
+
+        return self._one_input("TimestampsWatermarks", _WmOperator)
+
+
+class KeyedStream(DataStream):
+    def __init__(self, env, transformation: Transformation, key_extractor,
+                 key_spec: KeySpec):
+        super().__init__(env, transformation)
+        self.key_extractor = key_extractor
+        self.key_spec = key_spec
+
+    def process(self, fn: ProcessFunction, name: str = "KeyedProcess",
+                parallelism: Optional[int] = None) -> "DataStream":
+        from ..runtime.operators.simple import KeyedProcessOperator
+        ke = self.key_extractor
+        return self._one_input(
+            name, lambda: KeyedProcessOperator(fn, ke, name=name),
+            parallelism, key_extractor=ke)
+
+    # -- rolling (non-windowed) aggregation -------------------------------
+    def reduce(self, fn, name: str = "KeyedReduce") -> "DataStream":
+        rf = as_reduce(fn)
+        ke = self.key_extractor
+
+        from ..core.functions import ProcessFunction as PF
+        from ..runtime.operators.simple import KeyedProcessOperator
+        from ..state.descriptors import ReducingStateDescriptor
+
+        class _RollingReduce(PF):
+            def open(self, ctx):
+                self._desc = ReducingStateDescriptor("rolling-reduce", rf)
+                self._ctx = ctx
+
+            def process_element(self, value, ctx, out):
+                state = self._ctx.get_state(self._desc)
+                state.add(value)
+                out.collect(state.get(), ctx.timestamp)
+
+        return self._one_input(
+            name, lambda: KeyedProcessOperator(_RollingReduce(), ke, name=name),
+            key_extractor=ke)
+
+    def sum(self, field: Union[str, int], name: str = "KeyedSum") -> "DataStream":
+        return self._rolling_builtin("sum", field, name)
+
+    def min(self, field: Union[str, int], name: str = "KeyedMin") -> "DataStream":
+        return self._rolling_builtin("min", field, name)
+
+    def max(self, field: Union[str, int], name: str = "KeyedMax") -> "DataStream":
+        return self._rolling_builtin("max", field, name)
+
+    def _rolling_builtin(self, kind: str, field, name: str) -> "DataStream":
+        import operator as _op
+        pick = (_op.itemgetter(field) if isinstance(field, int)
+                else _op.itemgetter(field))
+
+        def combine(a, b):
+            va, vb = pick(a), pick(b)
+            if kind == "sum":
+                v = va + vb
+            elif kind == "min":
+                v = min(va, vb)
+            else:
+                v = max(va, vb)
+            # keep latest record's other fields, replace aggregated field
+            if isinstance(b, tuple):
+                out = list(b)
+                out[field if isinstance(field, int) else 0] = v
+                return tuple(out)
+            return v
+
+        if isinstance(field, str):
+            raise NotImplementedError(
+                "string fields on rolling agg need tuple index; use window "
+                "aggregation or pass an int index")
+        return self.reduce(combine, name)
+
+    # -- windows -----------------------------------------------------------
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        return WindowedStream(self, assigner)
+
+    def count_window(self, size: int) -> "WindowedStream":
+        return WindowedStream(self, GlobalWindows.create(),
+                              trigger=PurgingTrigger.of(CountTrigger.of(size)))
+
+
+class WindowedStream:
+    """(reference WindowedStream): keyed stream + assigner + trigger/evictor
+    builder, terminating in reduce/aggregate/apply."""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner,
+                 trigger: Optional[Trigger] = None,
+                 evictor: Optional[Evictor] = None, all_windows: bool = False):
+        self.keyed = keyed
+        self.assigner = assigner
+        self._trigger = trigger
+        self._evictor = evictor
+        self._lateness = 0
+        self._late_tag: Optional[str] = None
+        self._all = all_windows
+
+    def trigger(self, trigger: Trigger) -> "WindowedStream":
+        self._trigger = trigger
+        return self
+
+    def evictor(self, evictor: Evictor) -> "WindowedStream":
+        self._evictor = evictor
+        return self
+
+    def allowed_lateness(self, ms: int) -> "WindowedStream":
+        self._lateness = int(ms)
+        return self
+
+    def side_output_late_data(self, tag: str = "late-data") -> "WindowedStream":
+        self._late_tag = tag
+        return self
+
+    def _build(self, name, aggregate=None, reduce=None, window_fn=None,
+               out_schema=None) -> DataStream:
+        from ..runtime.operators.window import WindowOperator
+        assigner, trigger, evictor = self.assigner, self._trigger, self._evictor
+        lateness, late = self._lateness, self._late_tag
+        ke = self.keyed.key_extractor
+
+        def factory():
+            return WindowOperator(
+                assigner, ke, aggregate=aggregate, reduce=reduce,
+                window_fn=window_fn, trigger=trigger, evictor=evictor,
+                allowed_lateness=lateness, emit_late_data=late is not None,
+                out_schema=out_schema, name=name)
+
+        par = 1 if self._all else None
+        return self.keyed._one_input(name, factory, parallelism=par,
+                                     key_extractor=ke)
+
+    def reduce(self, fn, name: str = "WindowReduce",
+               window_fn=None) -> DataStream:
+        return self._build(name, reduce=as_reduce(fn), window_fn=window_fn)
+
+    def aggregate(self, fn: AggregateFunction, name: str = "WindowAggregate",
+                  window_fn=None) -> DataStream:
+        return self._build(name, aggregate=fn, window_fn=window_fn)
+
+    def apply(self, window_fn, name: str = "WindowApply") -> DataStream:
+        """window_fn(key, window, elements:list) -> iterable of rows."""
+        return self._build(name, window_fn=window_fn)
+
+    def sum(self, field: Union[str, int], name: str = "WindowSum") -> DataStream:
+        return self._builtin_agg("sum", field, name)
+
+    def min(self, field: Union[str, int], name: str = "WindowMin") -> DataStream:
+        return self._builtin_agg("min", field, name)
+
+    def max(self, field: Union[str, int], name: str = "WindowMax") -> DataStream:
+        return self._builtin_agg("max", field, name)
+
+    def count(self, name: str = "WindowCount") -> DataStream:
+        return self._builtin_agg("count", None, name)
+
+    def _builtin_agg(self, kind: str, field, name: str) -> DataStream:
+        import operator as _op
+
+        class _Builtin(AggregateFunction):
+            """Field-wise builtin aggregate. ``bind_schema`` resolves a
+            string field to the tuple index of the actual batch schema at
+            runtime (the operator calls it per batch); the device window
+            operator recognizes ``kind``/``field`` and lowers this to a
+            segment-reduce instead of calling add() per row."""
+
+            builtin_kind = kind
+            builtin_field = field
+
+            def __init__(self):
+                if field is None:
+                    self._pick = None          # count
+                elif isinstance(field, int):
+                    self._pick = _op.itemgetter(field)
+                else:
+                    self._pick = None          # resolved via bind_schema
+
+            def bind_schema(self, schema):
+                if isinstance(field, str):
+                    if len(schema) == 1:
+                        self._pick = lambda v: v
+                    else:
+                        self._pick = _op.itemgetter(schema.index_of(field))
+
+            def create_accumulator(self):
+                return None
+
+            def add(self, value, acc):
+                pick = self._pick
+                v = 1 if pick is None and field is None else pick(value)
+                if acc is None:
+                    return v
+                if kind in ("sum", "count"):
+                    return acc + v
+                return min(acc, v) if kind == "min" else max(acc, v)
+
+            def merge(self, a, b):
+                if a is None:
+                    return b
+                if b is None:
+                    return a
+                if kind in ("sum", "count"):
+                    return a + b
+                return min(a, b) if kind == "min" else max(a, b)
+
+            def get_result(self, acc):
+                return acc
+
+        return self._build(name, aggregate=_Builtin())
+
+
+class ConnectedStreams:
+    """Two streams into one two-input operator (reference ConnectedStreams)."""
+
+    def __init__(self, env, first: DataStream, second: DataStream):
+        self.env = env
+        self.first = first
+        self.second = second
+
+    def transform(self, name: str, operator_factory,
+                  parallelism: Optional[int] = None) -> DataStream:
+        t = TwoInputTransformation(
+            name=name, operator_factory=operator_factory,
+            parallelism=parallelism,
+            inputs=[self.first.transformation, self.second.transformation])
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)

@@ -1,0 +1,242 @@
+"""Connector SPI: split-based sources and two-phase sinks.
+
+Analog of flink-core's FLIP-27 / Sink V2 APIs
+(api/connector/source/Source.java:33, SourceReader.java:56,
+SplitEnumerator.java:34; api/connector/sink2/{Sink,SinkWriter,Committer}).
+The enumerator runs on the coordinator and hands splits to per-subtask
+readers; readers produce RecordBatches and snapshot their position so
+checkpoints capture exact replay offsets.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..core.records import MIN_TIMESTAMP, RecordBatch, Schema
+
+__all__ = [
+    "SourceSplit", "Source", "SourceReader", "Sink", "SinkWriter",
+    "CollectionSource", "DataGenSource", "CollectSink", "PrintSink",
+]
+
+
+@dataclass(frozen=True)
+class SourceSplit:
+    split_id: str
+    payload: Any = None
+
+
+class Source:
+    """Bounded or unbounded split-based source."""
+
+    bounded: bool = True
+    schema: Optional[Schema] = None
+
+    def create_splits(self, parallelism: int) -> list[SourceSplit]:
+        raise NotImplementedError
+
+    def create_reader(self, split: SourceSplit) -> "SourceReader":
+        raise NotImplementedError
+
+
+class SourceReader:
+    """Per-subtask reader over one split (reference SourceReader.java:56)."""
+
+    def read_batch(self, max_records: int) -> Optional[RecordBatch]:
+        """Next batch, an empty batch if nothing available right now, or
+        None when the split is exhausted."""
+        raise NotImplementedError
+
+    def snapshot(self) -> Any:
+        return None
+
+    def restore(self, state: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class Sink:
+    def create_writer(self, subtask_index: int) -> "SinkWriter":
+        raise NotImplementedError
+
+
+class SinkWriter:
+    def write_batch(self, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        """Pre-commit flush at checkpoint barriers (two-phase phase 1)."""
+
+    def snapshot(self) -> Any:
+        return None
+
+    def restore(self, state: Any) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Built-ins
+# ---------------------------------------------------------------------------
+
+class CollectionSource(Source):
+    """Bounded source over an in-memory collection (reference
+    fromCollection/fromElements). Splits round-robin across subtasks."""
+
+    def __init__(self, elements: Sequence[Any], schema: Optional[Schema] = None,
+                 timestamps: Optional[Sequence[int]] = None,
+                 batch_size: int = 1024):
+        self._elements = list(elements)
+        self.schema = schema or (Schema.infer(self._elements[0])
+                                 if self._elements else Schema.of(value=object))
+        self._timestamps = list(timestamps) if timestamps is not None else None
+        self._batch_size = batch_size
+
+    def create_splits(self, parallelism: int) -> list[SourceSplit]:
+        return [SourceSplit(f"collection-{i}", i) for i in range(parallelism)]
+
+    def create_reader(self, split: SourceSplit) -> SourceReader:
+        stride = int(split.split_id.rsplit("-", 1)[1])
+        return _CollectionReader(self, stride)
+
+    def num_subtask_elements(self, subtask: int, parallelism: int) -> list:
+        return self._elements[subtask::parallelism]
+
+
+class _CollectionReader(SourceReader):
+    def __init__(self, source: CollectionSource, stride_start: int):
+        self._source = source
+        self._stride_start = stride_start
+        self._pos = 0  # position within this reader's strided view
+
+    def _my_indices(self) -> range:
+        total = len(self._source._elements)
+        return range(self._stride_start, total, self._parallelism)
+
+    _parallelism = 1  # set by the task before reading
+
+    def read_batch(self, max_records: int) -> Optional[RecordBatch]:
+        idx = list(self._my_indices())[self._pos:self._pos + max_records]
+        if not idx:
+            return None
+        rows = [self._source._elements[i] for i in idx]
+        ts = ([self._source._timestamps[i] for i in idx]
+              if self._source._timestamps is not None else None)
+        self._pos += len(idx)
+        return RecordBatch.from_rows(self._source.schema, rows, ts)
+
+    def snapshot(self) -> Any:
+        return self._pos
+
+    def restore(self, state: Any) -> None:
+        self._pos = int(state)
+
+
+class DataGenSource(Source):
+    """Rate-limitable generator source (reference flink-connector-datagen):
+    gen_fn(index_array) -> dict of columns. Resume is exact: the only state
+    is the next index."""
+
+    def __init__(self, gen_fn: Callable[[np.ndarray], dict[str, np.ndarray]],
+                 schema: Schema, count: Optional[int] = None,
+                 rate_per_sec: Optional[float] = None,
+                 timestamp_column: Optional[str] = None):
+        self._gen = gen_fn
+        self.schema = schema
+        self._count = count
+        self.bounded = count is not None
+        self._rate = rate_per_sec
+        self._ts_col = timestamp_column
+
+    def create_splits(self, parallelism: int) -> list[SourceSplit]:
+        return [SourceSplit(f"datagen-{i}", (i, parallelism))
+                for i in range(parallelism)]
+
+    def create_reader(self, split: SourceSplit) -> SourceReader:
+        subtask, parallelism = split.payload
+        return _DataGenReader(self, subtask, parallelism)
+
+
+class _DataGenReader(SourceReader):
+    def __init__(self, source: DataGenSource, subtask: int, parallelism: int):
+        self._s = source
+        self._subtask = subtask
+        self._parallelism = parallelism
+        self._next = 0
+        self._started = time.time()
+
+    def read_batch(self, max_records: int) -> Optional[RecordBatch]:
+        share = None
+        if self._s._count is not None:
+            total = self._s._count
+            share = total // self._parallelism + (
+                1 if self._subtask < total % self._parallelism else 0)
+            if self._next >= share:
+                return None
+        n = max_records if share is None else min(max_records, share - self._next)
+        if self._s._rate is not None:
+            # admission control: stay under rate_per_sec for this subtask
+            allowed = int((time.time() - self._started) * self._s._rate) \
+                - self._next
+            n = min(n, max(allowed, 0))
+            if n == 0:
+                return RecordBatch.empty(self._s.schema)
+        # global indices strided by subtask for determinism under parallelism
+        idx = (self._next + np.arange(n)) * self._parallelism + self._subtask
+        cols = self._s._gen(idx.astype(np.int64))
+        self._next += n
+        batch = RecordBatch(self._s.schema, cols)
+        if self._s._ts_col is not None:
+            batch = batch.with_timestamps(
+                batch.column(self._s._ts_col).astype(np.int64))
+        return batch
+
+    def snapshot(self) -> Any:
+        return self._next
+
+    def restore(self, state: Any) -> None:
+        self._next = int(state)
+
+
+class CollectSink(Sink):
+    """Collects rows into a shared list — the test/ITCase sink
+    (reference DataStream.executeAndCollect)."""
+
+    def __init__(self):
+        self.rows: list = []
+        import threading
+        self._lock = threading.Lock()
+
+    def create_writer(self, subtask_index: int) -> SinkWriter:
+        sink = self
+
+        class _W(SinkWriter):
+            def write_batch(self, batch: RecordBatch) -> None:
+                with sink._lock:
+                    sink.rows.extend(batch.iter_rows())
+
+        return _W()
+
+
+class PrintSink(Sink):
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix
+
+    def create_writer(self, subtask_index: int) -> SinkWriter:
+        prefix = self._prefix
+
+        class _W(SinkWriter):
+            def write_batch(self, batch: RecordBatch) -> None:
+                for row in batch.iter_rows():
+                    print(f"{prefix}{row}")
+
+        return _W()

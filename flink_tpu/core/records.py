@@ -162,6 +162,64 @@ class RecordBatch:
         return cls(schema, arrs, ts)
 
     @classmethod
+    def from_rows_infer(cls, schema: Optional[Schema], rows: Sequence[Any],
+                        timestamps: Optional[Sequence[int]] = None
+                        ) -> tuple["RecordBatch", Schema]:
+        """from_rows with inference + per-column promotion: user functions may
+        emit heterogeneous rows, so each column that stops fitting its
+        inferred dtype is promoted along int64 -> float64 -> object (never
+        silently truncated); the promoted schema is returned for reuse so
+        later batches stay consistent. Only the offending column widens —
+        numeric siblings keep their dtype (and their device path)."""
+        if not rows:
+            if schema is None:
+                raise ValueError(
+                    "from_rows_infer needs a schema to build an empty batch")
+            return cls.empty(schema), schema
+        if schema is None:
+            schema = Schema.infer(rows[0])
+        # gather per-column python lists (same row-shape handling as from_rows)
+        n = len(rows)
+        single = len(schema) == 1
+        cols: dict[str, list] = {f.name: [None] * n for f in schema.fields}
+        for i, row in enumerate(rows):
+            if isinstance(row, dict):
+                for f in schema.fields:
+                    cols[f.name][i] = row[f.name]
+            elif isinstance(row, tuple) and not single:
+                for f, v in zip(schema.fields, row):
+                    cols[f.name][i] = v
+            else:
+                cols[schema.fields[0].name][i] = row
+
+        out_fields: list[tuple[str, Any]] = []
+        arrs: dict[str, np.ndarray] = {}
+        for f in schema.fields:
+            vals = cols[f.name]
+            if not f.is_numeric:
+                arrs[f.name] = np.array(vals, dtype=object)
+                out_fields.append((f.name, object))
+                continue
+            try:
+                natural = np.asarray(vals)
+            except (ValueError, TypeError):
+                natural = np.array(vals, dtype=object)
+            if natural.dtype == object or natural.dtype.kind in "USV":
+                arrs[f.name] = np.array(vals, dtype=object)
+                out_fields.append((f.name, object))
+            elif np.can_cast(natural.dtype, f.dtype, "safe"):
+                arrs[f.name] = natural.astype(f.dtype)
+                out_fields.append((f.name, f.dtype))
+            else:
+                promoted = np.promote_types(natural.dtype, np.dtype(f.dtype))
+                arrs[f.name] = natural.astype(promoted)
+                out_fields.append((f.name, promoted.type))
+        out_schema = Schema(out_fields)
+        ts = None if timestamps is None else np.asarray(timestamps,
+                                                       dtype=np.int64)
+        return cls(out_schema, arrs, ts), out_schema
+
+    @classmethod
     def empty(cls, schema: Schema) -> "RecordBatch":
         cols = {f.name: np.empty(0, dtype=f.dtype if f.is_numeric else object)
                 for f in schema.fields}

@@ -1,0 +1,304 @@
+"""StreamGraph and JobGraph: graph compilation with operator chaining.
+
+Analog of the reference's two-stage translation
+(flink-streaming-java api/graph/StreamGraphGenerator.java:136 generate():320
+and StreamingJobGraphGenerator.java:129 createJobGraph:136): the
+Transformation DAG flattens into a StreamGraph (nodes + partitioned edges;
+unions dissolve into plain edges), then chainable runs fuse into JobVertices.
+
+A chained JobVertex is the TPU fusion unit: all its operators execute in one
+task, and when all are jax-traceable the whole chain compiles into one XLA
+program. Chaining rule (reference StreamingJobGraphGenerator.isChainable):
+forward edge + equal parallelism + single in-edge + chaining enabled on both
+nodes + same slot-sharing group.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.config import Configuration, PipelineOptions
+from .transformations import (
+    OneInputTransformation, PartitionTransformation, SideOutputTransformation,
+    SinkTransformation, SourceTransformation, Transformation,
+    TwoInputTransformation, UnionTransformation,
+)
+
+__all__ = ["StreamNode", "StreamEdge", "StreamGraph", "JobVertex", "JobEdge",
+           "JobGraph", "build_stream_graph", "build_job_graph"]
+
+
+@dataclass
+class StreamNode:
+    id: int
+    name: str
+    kind: str  # source | one_input | two_input | sink
+    parallelism: int
+    max_parallelism: int
+    uid: str = ""
+    chaining_allowed: bool = True
+    slot_sharing_group: str = "default"
+    operator_factory: Optional[Callable] = None
+    key_extractor: Optional[Callable] = None
+    key_extractor2: Optional[Callable] = None
+    source: Any = None
+    watermark_strategy: Any = None
+    traceable: bool = False
+
+
+@dataclass
+class StreamEdge:
+    source_id: int
+    target_id: int
+    partitioner_factory: Callable[[], Any]
+    partitioner_name: str = "forward"
+    side_tag: Optional[str] = None
+    target_input: int = 0  # 0/1 for two-input operators
+
+
+@dataclass
+class StreamGraph:
+    nodes: dict[int, StreamNode] = field(default_factory=dict)
+    edges: list[StreamEdge] = field(default_factory=list)
+
+    def in_edges(self, node_id: int) -> list[StreamEdge]:
+        return [e for e in self.edges if e.target_id == node_id]
+
+    def out_edges(self, node_id: int) -> list[StreamEdge]:
+        return [e for e in self.edges if e.source_id == node_id]
+
+
+def build_stream_graph(sinks: list[Transformation],
+                       config: Configuration) -> StreamGraph:
+    """Flatten the transformation DAG; virtual nodes (partition/union/side
+    output) dissolve into edge attributes (reference StreamGraphGenerator
+    virtual transformations)."""
+    g = StreamGraph()
+    default_par = config.get(PipelineOptions.DEFAULT_PARALLELISM)
+    default_maxp = config.get(PipelineOptions.MAX_PARALLELISM)
+    visited: dict[int, int] = {}  # transformation id -> stream node id
+
+    def resolve_input(t: Transformation) -> list[tuple[int, dict]]:
+        """Resolve a transformation to (upstream node id, edge attrs) pairs,
+        dissolving virtual nodes."""
+        if isinstance(t, PartitionTransformation):
+            out = []
+            for up in t.inputs:
+                for nid, attrs in resolve_input(up):
+                    a = dict(attrs)
+                    a["partitioner_factory"] = t.partitioner_factory
+                    a["partitioner_name"] = t.partitioner_name
+                    out.append((nid, a))
+            return out
+        if isinstance(t, UnionTransformation):
+            out = []
+            for up in t.inputs:
+                out.extend(resolve_input(up))
+            return out
+        if isinstance(t, SideOutputTransformation):
+            out = []
+            for up in t.inputs:
+                for nid, attrs in resolve_input(up):
+                    a = dict(attrs)
+                    a["side_tag"] = t.tag
+                    out.append((nid, a))
+            return out
+        return [(visit(t), {})]
+
+    def visit(t: Transformation) -> int:
+        if t.id in visited:
+            return visited[t.id]
+        if isinstance(t, (PartitionTransformation, UnionTransformation,
+                          SideOutputTransformation)):
+            raise AssertionError("virtual nodes resolve through resolve_input")
+
+        par = t.parallelism or default_par
+        maxp = t.max_parallelism or default_maxp
+        if isinstance(t, SourceTransformation):
+            node = StreamNode(t.id, t.name, "source", par, maxp,
+                              uid=t.effective_uid,
+                              chaining_allowed=t.chaining_allowed,
+                              slot_sharing_group=t.slot_sharing_group,
+                              source=t.source,
+                              watermark_strategy=t.watermark_strategy)
+        elif isinstance(t, SinkTransformation):
+            node = StreamNode(t.id, t.name, "sink", par, maxp,
+                              uid=t.effective_uid,
+                              chaining_allowed=t.chaining_allowed,
+                              slot_sharing_group=t.slot_sharing_group,
+                              operator_factory=t.operator_factory)
+        elif isinstance(t, TwoInputTransformation):
+            node = StreamNode(t.id, t.name, "two_input", par, maxp,
+                              uid=t.effective_uid,
+                              chaining_allowed=t.chaining_allowed,
+                              slot_sharing_group=t.slot_sharing_group,
+                              operator_factory=t.operator_factory,
+                              key_extractor=t.key_extractor1,
+                              key_extractor2=t.key_extractor2)
+        elif isinstance(t, OneInputTransformation):
+            node = StreamNode(t.id, t.name, "one_input", par, maxp,
+                              uid=t.effective_uid,
+                              chaining_allowed=t.chaining_allowed,
+                              slot_sharing_group=t.slot_sharing_group,
+                              operator_factory=t.operator_factory,
+                              key_extractor=t.key_extractor,
+                              traceable=t.traceable)
+        else:
+            raise TypeError(f"Unknown transformation {type(t)}")
+        g.nodes[node.id] = node
+        visited[t.id] = node.id
+
+        if isinstance(t, TwoInputTransformation):
+            for input_idx, up in enumerate(t.inputs):
+                for nid, attrs in resolve_input(up):
+                    g.edges.append(_make_edge(nid, node.id, attrs, input_idx))
+        else:
+            for up in t.inputs:
+                for nid, attrs in resolve_input(up):
+                    g.edges.append(_make_edge(nid, node.id, attrs, 0))
+        return node.id
+
+    for s in sinks:
+        visit(s)
+    return g
+
+
+def _make_edge(source_id: int, target_id: int, attrs: dict,
+               target_input: int) -> StreamEdge:
+    from ..runtime.writer import ForwardPartitioner
+    return StreamEdge(
+        source_id, target_id,
+        partitioner_factory=attrs.get("partitioner_factory",
+                                      ForwardPartitioner),
+        partitioner_name=attrs.get("partitioner_name", "forward"),
+        side_tag=attrs.get("side_tag"),
+        target_input=target_input)
+
+
+# ---------------------------------------------------------------------------
+# JobGraph: chained vertices
+# ---------------------------------------------------------------------------
+
+@dataclass
+class JobEdge:
+    source_vertex: str
+    target_vertex: str
+    partitioner_factory: Callable[[], Any]
+    partitioner_name: str = "forward"
+    side_tag: Optional[str] = None
+    target_input: int = 0
+
+
+@dataclass
+class JobVertex:
+    id: str
+    name: str
+    parallelism: int
+    max_parallelism: int
+    chained_nodes: list[StreamNode] = field(default_factory=list)
+    slot_sharing_group: str = "default"
+
+    @property
+    def kind(self) -> str:
+        return self.chained_nodes[0].kind
+
+    @property
+    def is_traceable_chain(self) -> bool:
+        return all(n.traceable for n in self.chained_nodes
+                   if n.kind == "one_input")
+
+
+@dataclass
+class JobGraph:
+    name: str
+    vertices: dict[str, JobVertex] = field(default_factory=dict)
+    edges: list[JobEdge] = field(default_factory=list)
+    config: Configuration = field(default_factory=Configuration)
+
+    def in_edges(self, vid: str) -> list[JobEdge]:
+        return [e for e in self.edges if e.target_vertex == vid]
+
+    def out_edges(self, vid: str) -> list[JobEdge]:
+        return [e for e in self.edges if e.source_vertex == vid]
+
+    def topological_order(self) -> list[JobVertex]:
+        order, seen = [], set()
+
+        def dfs(vid: str):
+            if vid in seen:
+                return
+            seen.add(vid)
+            for e in self.in_edges(vid):
+                dfs(e.source_vertex)
+            order.append(self.vertices[vid])
+
+        for vid in self.vertices:
+            dfs(vid)
+        return order
+
+
+def build_job_graph(g: StreamGraph, config: Configuration,
+                    name: str = "job") -> JobGraph:
+    chaining = config.get(PipelineOptions.CHAINING_ENABLED)
+
+    def chainable(e: StreamEdge) -> bool:
+        if not chaining or e.side_tag is not None:
+            return False
+        up, down = g.nodes[e.source_id], g.nodes[e.target_id]
+        return (e.partitioner_name == "forward"
+                and up.parallelism == down.parallelism
+                and up.slot_sharing_group == down.slot_sharing_group
+                and down.kind in ("one_input", "sink")
+                and down.chaining_allowed and up.chaining_allowed
+                and len(g.in_edges(down.id)) == 1
+                and len(g.out_edges(up.id)) == 1)
+
+    # map each stream node to the head of its chain
+    head_of: dict[int, int] = {}
+    for nid in g.nodes:
+        head = nid
+        while True:
+            ins = g.in_edges(head)
+            if len(ins) == 1 and chainable(ins[0]):
+                head = ins[0].source_id
+            else:
+                break
+        head_of[nid] = head
+
+    jg = JobGraph(name=name, config=config)
+    # build chains in order
+    for nid, node in g.nodes.items():
+        if head_of[nid] != nid:
+            continue
+        chain = [node]
+        cur = nid
+        while True:
+            outs = g.out_edges(cur)
+            if len(outs) == 1 and chainable(outs[0]):
+                cur = outs[0].target_id
+                chain.append(g.nodes[cur])
+            else:
+                break
+        head = chain[0]
+        vid = f"v{head.id}"
+        jg.vertices[vid] = JobVertex(
+            id=vid,
+            name=" -> ".join(n.name for n in chain),
+            parallelism=head.parallelism,
+            max_parallelism=head.max_parallelism,
+            chained_nodes=chain,
+            slot_sharing_group=head.slot_sharing_group)
+
+    # edges between chains
+    for e in g.edges:
+        src_head, dst_head = head_of[e.source_id], head_of[e.target_id]
+        if src_head == dst_head:
+            continue  # intra-chain edge, consumed by chaining
+        jg.edges.append(JobEdge(
+            source_vertex=f"v{src_head}", target_vertex=f"v{dst_head}",
+            partitioner_factory=e.partitioner_factory,
+            partitioner_name=e.partitioner_name,
+            side_tag=e.side_tag, target_input=e.target_input))
+    return jg

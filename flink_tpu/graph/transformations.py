@@ -1,0 +1,91 @@
+"""Transformation DAG: what the fluent API records.
+
+Analog of flink-core/streaming transformations
+(api/dag/Transformation, flink-streaming-java transformations/
+OneInputTransformation, PartitionTransformation, SourceTransformation,
+SinkTransformation, UnionTransformation): a lazy DAG the environment
+translates into a StreamGraph (graph/stream_graph.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from ..core.records import Schema
+from ..core.watermarks import WatermarkStrategy
+
+__all__ = [
+    "Transformation", "SourceTransformation", "OneInputTransformation",
+    "TwoInputTransformation", "PartitionTransformation", "UnionTransformation",
+    "SinkTransformation", "SideOutputTransformation",
+]
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Transformation:
+    name: str
+    parallelism: Optional[int] = None
+    max_parallelism: Optional[int] = None
+    schema: Optional[Schema] = None
+    inputs: list["Transformation"] = field(default_factory=list)
+    id: int = field(default_factory=lambda: next(_ids))
+    chaining_allowed: bool = True
+    slot_sharing_group: str = "default"
+    uid: Optional[str] = None  # stable operator id for savepoint mapping
+
+    @property
+    def effective_uid(self) -> str:
+        return self.uid or f"op-{self.id}"
+
+
+@dataclass
+class SourceTransformation(Transformation):
+    source: Any = None
+    watermark_strategy: WatermarkStrategy = field(
+        default_factory=WatermarkStrategy.no_watermarks)
+
+
+@dataclass
+class OneInputTransformation(Transformation):
+    """operator_factory() -> OneInputOperator (fresh instance per subtask)."""
+
+    operator_factory: Callable[[], Any] = None
+    # keyed inputs: extractor recomputed downstream for state addressing
+    key_extractor: Optional[Callable] = None
+    traceable: bool = False  # whole operator is jax-traceable (fusable)
+
+
+@dataclass
+class TwoInputTransformation(Transformation):
+    operator_factory: Callable[[], Any] = None
+    key_extractor1: Optional[Callable] = None
+    key_extractor2: Optional[Callable] = None
+
+
+@dataclass
+class PartitionTransformation(Transformation):
+    """Repartitioning edge (reference PartitionTransformation): carries a
+    partitioner factory so each upstream subtask gets a fresh stateful
+    partitioner (round-robin counters etc.)."""
+
+    partitioner_factory: Callable[[], Any] = None
+    partitioner_name: str = "forward"
+
+
+@dataclass
+class UnionTransformation(Transformation):
+    pass
+
+
+@dataclass
+class SinkTransformation(Transformation):
+    operator_factory: Callable[[], Any] = None
+
+
+@dataclass
+class SideOutputTransformation(Transformation):
+    tag: str = ""

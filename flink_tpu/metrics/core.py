@@ -1,0 +1,173 @@
+"""Metrics: counters/gauges/meters/histograms in scoped groups.
+
+Analog of flink-metrics-core (MetricGroup.java:36, Counter/Gauge/Histogram/
+Meter) and the runtime registry (MetricRegistryImpl.java:74) with scoped
+groups per job/task/operator. Reporters (metrics/reporters.py) poll the
+registry on an interval, like the reference's reporter setup.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+__all__ = ["Counter", "Gauge", "Meter", "Histogram", "MetricGroup",
+           "MetricRegistry", "TaskMetrics"]
+
+
+class Counter:
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    def dec(self, n: int = 1) -> None:
+        self._value -= n
+
+    @property
+    def count(self) -> int:
+        return self._value
+
+
+class Gauge:
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+
+    @property
+    def value(self) -> Any:
+        return self._fn()
+
+
+class Meter:
+    """Rate over a sliding minute (reference MeterView)."""
+
+    def __init__(self):
+        self._events: deque[tuple[float, int]] = deque()
+        self._count = 0
+
+    def mark(self, n: int = 1) -> None:
+        self._count += n
+        now = time.time()
+        self._events.append((now, n))
+        cutoff = now - 60.0
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    @property
+    def rate(self) -> float:
+        now = time.time()
+        recent = sum(n for t, n in self._events if t >= now - 60.0)
+        return recent / 60.0
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class Histogram:
+    """Reservoir histogram with quantiles."""
+
+    def __init__(self, window: int = 1024):
+        self._values: deque[float] = deque(maxlen=window)
+
+    def update(self, value: float) -> None:
+        self._values.append(float(value))
+
+    def quantile(self, q: float) -> float:
+        if not self._values:
+            return 0.0
+        vals = sorted(self._values)
+        idx = min(int(q * len(vals)), len(vals) - 1)
+        return vals[idx]
+
+    @property
+    def count(self) -> int:
+        return len(self._values)
+
+    @property
+    def mean(self) -> float:
+        return sum(self._values) / len(self._values) if self._values else 0.0
+
+
+class MetricGroup:
+    """Hierarchical scope: registry.group('job').group('task')..."""
+
+    def __init__(self, registry: "MetricRegistry", scope: tuple[str, ...]):
+        self._registry = registry
+        self.scope = scope
+
+    def group(self, name: str) -> "MetricGroup":
+        return MetricGroup(self._registry, self.scope + (name,))
+
+    def _register(self, name: str, metric) -> Any:
+        self._registry.register(self.scope + (name,), metric)
+        return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._register(name, Counter())
+
+    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+        return self._register(name, Gauge(fn))
+
+    def meter(self, name: str) -> Meter:
+        return self._register(name, Meter())
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        return self._register(name, Histogram(window))
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._metrics: dict[tuple[str, ...], Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, scope: tuple[str, ...], metric) -> None:
+        with self._lock:
+            self._metrics[scope] = metric
+
+    def root(self) -> MetricGroup:
+        return MetricGroup(self, ())
+
+    def all_metrics(self) -> dict[tuple[str, ...], Any]:
+        with self._lock:
+            return dict(self._metrics)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Flat name -> numeric value view for reporters."""
+        out: dict[str, Any] = {}
+        for scope, m in self.all_metrics().items():
+            name = ".".join(scope)
+            if isinstance(m, Counter):
+                out[name] = m.count
+            elif isinstance(m, Gauge):
+                try:
+                    out[name] = m.value
+                except Exception:  # noqa: BLE001 - gauge fn may race shutdown
+                    out[name] = None
+            elif isinstance(m, Meter):
+                out[name + ".rate"] = m.rate
+                out[name + ".count"] = m.count
+            elif isinstance(m, Histogram):
+                out[name + ".p50"] = m.quantile(0.50)
+                out[name + ".p99"] = m.quantile(0.99)
+                out[name + ".mean"] = m.mean
+        return out
+
+
+class TaskMetrics:
+    """Standard per-task IO metrics (reference numRecordsIn/Out,
+    busy/backpressure gauges)."""
+
+    def __init__(self, registry: MetricRegistry, job: str, vertex: str,
+                 subtask: int):
+        g = registry.root().group(job).group(vertex).group(str(subtask))
+        self.records_in = g.counter("numRecordsIn")
+        self.records_out = g.counter("numRecordsOut")
+        self.watermark_lag = g.histogram("watermarkLag")
+        self.batch_size = g.histogram("batchSize")
+        self.group = g

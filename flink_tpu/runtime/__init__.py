@@ -1,0 +1,4 @@
+"""Runtime: tasks, channels, operators, timers, harness (SURVEY.md §2.5/L4)."""
+
+from .harness import OneInputOperatorTestHarness  # noqa: F401
+from .timers import InternalTimerService, Timer  # noqa: F401

@@ -1,0 +1,10 @@
+from .base import (  # noqa: F401
+    CollectingOutput, OneInputOperator, OperatorChain, OperatorContext,
+    Output, StreamOperator, TwoInputOperator,
+)
+from .simple import (  # noqa: F401
+    BatchFnOperator, FilterOperator, FlatMapOperator, KeyedProcessOperator,
+    MapOperator,
+)
+from .sink import FunctionSinkOperator, SinkOperator  # noqa: F401
+from .window import WindowOperator  # noqa: F401

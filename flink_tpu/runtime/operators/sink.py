@@ -1,0 +1,62 @@
+"""Sink operator: terminal operator wrapping a SinkWriter.
+
+Analog of the reference's SinkWriterOperator (sink2 runtime). Flushes on
+checkpoint (two-phase pre-commit) and snapshots writer state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...connectors.core import Sink, SinkWriter
+from ...core.functions import SinkFunction
+from ...core.records import RecordBatch
+from .base import OneInputOperator, OperatorContext, Output
+
+__all__ = ["SinkOperator", "FunctionSinkOperator"]
+
+
+class SinkOperator(OneInputOperator):
+    def __init__(self, sink: Sink, name: str = "Sink"):
+        super().__init__(name)
+        self._sink = sink
+        self._writer: Optional[SinkWriter] = None
+
+    def setup(self, ctx: OperatorContext, output: Output) -> None:
+        super().setup(ctx, output)
+        self._writer = self._sink.create_writer(ctx.subtask_index)
+
+    def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
+        if operator_snapshot is not None:
+            self._writer.restore(operator_snapshot)
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        self._writer.write_batch(batch)
+
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        self._writer.flush()
+        return {"operator": self._writer.snapshot()}
+
+    def finish(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class FunctionSinkOperator(OneInputOperator):
+    """Wraps a plain SinkFunction (reference StreamSink)."""
+
+    def __init__(self, fn: SinkFunction, name: str = "Sink"):
+        super().__init__(name)
+        self._fn = fn
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        if self._fn.invoke_batch(batch):
+            return
+        for i, row in enumerate(batch.iter_rows()):
+            ts = int(batch.timestamps[i])
+            self._fn.invoke(row, None if ts == -(1 << 62) else ts)
+
+    def close(self) -> None:
+        self._fn.close()

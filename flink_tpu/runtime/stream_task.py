@@ -1,0 +1,331 @@
+"""Stream tasks: the per-subtask execution loop.
+
+Analog of the reference's StreamTask family
+(flink-streaming-java runtime/tasks/: StreamTask.java:192 invoke():821 /
+processInput:588, SourceStreamTask, OneInputStreamTask) and its mailbox
+(mailbox/MailboxProcessor.java:67): a single thread per subtask alternates
+between the default action (process one input event) and 'mails' (checkpoint
+triggers, coordinator commands) — operators never see concurrency.
+
+Differences from the reference, by design:
+* input is batch-granular; micro-batch coalescing happens at sources;
+* backpressure is bounded-queue blocking (credit analog);
+* processing time advances from the loop between events, keeping tests
+  deterministic (a harness can inject a manual clock via OperatorContext).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.config import CheckpointingOptions, Configuration, PipelineOptions
+from ..core.elements import (
+    MAX_WATERMARK, CheckpointBarrier, EndOfInput, LatencyMarker, Watermark,
+    WatermarkStatus,
+)
+from ..core.records import MIN_TIMESTAMP, RecordBatch
+from ..core.watermarks import WatermarkStrategy
+from ..connectors.core import SinkWriter, Source, SourceReader
+from ..state.backend import OperatorStateBackend
+from .channels import GateEvent, InputGate
+from .operators.base import OperatorChain, OperatorContext, Output
+from .writer import RecordWriter
+
+__all__ = ["StreamTask", "SourceStreamTask", "OneInputStreamTask", "TaskReporter"]
+
+
+class TaskReporter:
+    """Callbacks from tasks to the control plane (analog of the
+    TaskExecutor->JobMaster RPC surface)."""
+
+    def acknowledge_checkpoint(self, task_id: str, checkpoint_id: int,
+                               snapshot: dict) -> None:
+        pass
+
+    def declined_checkpoint(self, task_id: str, checkpoint_id: int,
+                            reason: str) -> None:
+        pass
+
+    def task_finished(self, task_id: str) -> None:
+        pass
+
+    def task_failed(self, task_id: str, error: BaseException) -> None:
+        pass
+
+
+class _WriterFanout(Output):
+    """Chain tail output -> this task's RecordWriters. Control elements
+    (watermarks, latency markers) broadcast over side-output writers too —
+    downstream of a side edge still needs event time to advance."""
+
+    def __init__(self, writers: list[RecordWriter], metrics=None,
+                 side_writers: Optional[dict[str, list[RecordWriter]]] = None):
+        self._writers = writers
+        self._metrics = metrics
+        self._side = side_writers or {}
+
+    def _all_writers(self):
+        yield from self._writers
+        for ws in self._side.values():
+            yield from ws
+
+    def emit(self, batch: RecordBatch) -> None:
+        if self._metrics is not None:
+            self._metrics.records_out.inc(batch.n)
+        for w in self._writers:
+            w.emit(batch)
+
+    def emit_watermark(self, watermark: Watermark) -> None:
+        for w in self._all_writers():
+            w.emit_watermark(watermark)
+
+    def emit_latency_marker(self, marker: LatencyMarker) -> None:
+        for w in self._all_writers():
+            w.broadcast(marker)
+
+    def emit_side(self, tag: str, batch: RecordBatch) -> None:
+        for w in self._side.get(tag, ()):
+            w.emit(batch)
+
+
+class StreamTask:
+    """Base: mailbox + lifecycle + checkpoint plumbing."""
+
+    def __init__(self, task_id: str, ctx: OperatorContext,
+                 writers: list[RecordWriter], reporter: TaskReporter,
+                 config: Optional[Configuration] = None,
+                 side_writers: Optional[dict[str, list[RecordWriter]]] = None):
+        self.task_id = task_id
+        self.ctx = ctx
+        self.writers = writers
+        self.side_writers = side_writers or {}
+        self.reporter = reporter
+        self.config = config or ctx.config
+        self._mailbox: queue.Queue = queue.Queue()
+        self._cancelled = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.operator_state = OperatorStateBackend()
+        self._last_proc_time = 0
+
+    def all_writers(self):
+        yield from self.writers
+        for ws in self.side_writers.values():
+            yield from ws
+
+    def broadcast_all(self, element) -> None:
+        for w in self.all_writers():
+            w.broadcast(element)
+
+    def make_tail_output(self) -> "_WriterFanout":
+        return _WriterFanout(self.writers, self.ctx.metrics, self.side_writers)
+
+    # -- mailbox (reference MailboxProcessor) ------------------------------
+    def execute_in_mailbox(self, fn: Callable[[], None]) -> None:
+        self._mailbox.put(fn)
+
+    def _drain_mailbox(self) -> None:
+        while True:
+            try:
+                self._mailbox.get_nowait()()
+            except queue.Empty:
+                return
+
+    # -- control -----------------------------------------------------------
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self._run_safely,
+                                        name=self.task_id, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    @property
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run_safely(self) -> None:
+        try:
+            self.invoke()
+            self.reporter.task_finished(self.task_id)
+        except BaseException as e:  # noqa: BLE001 - report everything
+            if not self._cancelled.is_set():
+                self.reporter.task_failed(self.task_id, e)
+
+    def invoke(self) -> None:
+        raise NotImplementedError
+
+    # -- helpers -----------------------------------------------------------
+    def _advance_processing_time(self, chain: Optional[OperatorChain]) -> None:
+        now = self.ctx.processing_time()
+        if now > self._last_proc_time:
+            self._last_proc_time = now
+            if chain is not None:
+                chain.advance_processing_time(now)
+
+
+class SourceStreamTask(StreamTask):
+    """Runs one source reader; checkpoints are injected here by the
+    coordinator through the mailbox (reference triggerCheckpointAsync)."""
+
+    def __init__(self, task_id: str, ctx: OperatorContext, source: Source,
+                 reader: SourceReader, watermark_strategy: WatermarkStrategy,
+                 chain: Optional[OperatorChain], writers: list[RecordWriter],
+                 reporter: TaskReporter,
+                 config: Optional[Configuration] = None):
+        super().__init__(task_id, ctx, writers, reporter, config)
+        self.source = source
+        self.reader = reader
+        self.ws = watermark_strategy
+        self.chain = chain  # chained operators after the source, may be None
+        self._restored_reader_state: Any = None
+
+    def restore_state(self, snapshot: Optional[dict]) -> None:
+        if not snapshot:
+            return
+        if snapshot.get("reader") is not None:
+            self._restored_reader_state = snapshot["reader"]
+        if self.chain is not None and snapshot.get("chain"):
+            self.chain.initialize_state(snapshot["chain"])
+
+    def _snapshot(self, barrier: CheckpointBarrier) -> None:
+        # ① emit barrier downstream first (source is the barrier origin)
+        self.broadcast_all(barrier)
+        # ② snapshot reader position + chained operators
+        snap = {"reader": self.reader.snapshot(),
+                "chain": (self.chain.snapshot_state(barrier.checkpoint_id)
+                          if self.chain else None)}
+        self.reporter.acknowledge_checkpoint(
+            self.task_id, barrier.checkpoint_id, snap)
+
+    def trigger_checkpoint(self, barrier: CheckpointBarrier) -> None:
+        self.execute_in_mailbox(lambda: self._snapshot(barrier))
+
+    def invoke(self) -> None:
+        batch_size = self.config.get(PipelineOptions.BATCH_SIZE)
+        wm_interval = self.config.get(PipelineOptions.AUTO_WATERMARK_INTERVAL)
+        idle_timeout = self.ws.idle_timeout
+        if self._restored_reader_state is not None:
+            self.reader.restore(self._restored_reader_state)
+        gen = self.ws.create_generator()
+        out: Output = self.make_tail_output()
+        if self.chain is not None:
+            self.chain.open()
+        last_wm_emit = 0.0
+        last_wm = MIN_TIMESTAMP
+        last_data_time = time.time()
+        idle = False
+
+        while not self._cancelled.is_set():
+            self._drain_mailbox()
+            batch = self.reader.read_batch(batch_size)
+            if batch is None:  # exhausted (bounded)
+                break
+            if batch.n:
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.records_in.inc(batch.n)
+                batch = self.ws.assign_timestamps(batch)
+                gen.on_batch(batch)
+                last_data_time = time.time()
+                if idle:
+                    idle = False
+                    self.broadcast_all(WatermarkStatus(True))
+                if self.chain is not None:
+                    self.chain.process_batch(batch)
+                else:
+                    out.emit(batch)
+            else:
+                time.sleep(0.001)  # unbounded source, nothing available
+                if (idle_timeout is not None and not idle
+                        and time.time() - last_data_time > idle_timeout):
+                    idle = True
+                    self.broadcast_all(WatermarkStatus(False))
+            now = time.time()
+            if now - last_wm_emit >= wm_interval:
+                last_wm_emit = now
+                wm = gen.current_watermark()
+                if wm > last_wm and not idle:
+                    last_wm = wm
+                    if self.chain is not None:
+                        self.chain.process_watermark(Watermark(wm))
+                    else:
+                        out.emit_watermark(Watermark(wm))
+            self._advance_processing_time(self.chain)
+
+        if not self._cancelled.is_set():
+            self._drain_mailbox()
+            # bounded source done: flush event time, finish chain, close edges
+            final_wm = MAX_WATERMARK
+            if self.chain is not None:
+                self.chain.process_watermark(final_wm)
+                self.chain.finish()
+                self.chain.close()
+            else:
+                out.emit_watermark(final_wm)
+            self.broadcast_all(EndOfInput())
+        self.reader.close()
+
+
+class OneInputStreamTask(StreamTask):
+    """Gate -> operator chain -> writers (reference OneInputStreamTask)."""
+
+    def __init__(self, task_id: str, ctx: OperatorContext, gate: InputGate,
+                 chain: OperatorChain, writers: list[RecordWriter],
+                 reporter: TaskReporter,
+                 config: Optional[Configuration] = None):
+        super().__init__(task_id, ctx, writers, reporter, config)
+        self.gate = gate
+        self.chain = chain
+
+    def restore_state(self, snapshot: Optional[dict]) -> None:
+        if snapshot and snapshot.get("chain"):
+            self.chain.initialize_state(snapshot["chain"])
+
+    def _on_barrier(self, barrier: CheckpointBarrier) -> None:
+        """All barriers aligned: snapshot then forward (reference
+        SubtaskCheckpointCoordinatorImpl.checkpointState: broadcast barrier
+        downstream first, then snapshot operators)."""
+        self.broadcast_all(barrier)
+        snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
+        self.reporter.acknowledge_checkpoint(
+            self.task_id, barrier.checkpoint_id, snap)
+
+    def invoke(self) -> None:
+        self.chain.open()
+        out_watermark_sent = False
+        while not self._cancelled.is_set():
+            self._drain_mailbox()
+            ev = self.gate.poll()
+            if ev is None:
+                if self.gate.all_ended():
+                    break
+                self._advance_processing_time(self.chain)
+                time.sleep(0.0005)
+                continue
+            if ev.kind == "batch":
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.records_in.inc(ev.value.n)
+                self.chain.process_batch(ev.value)
+            elif ev.kind == "watermark":
+                self.chain.process_watermark(ev.value)
+            elif ev.kind == "barrier":
+                self._on_barrier(ev.value)
+            elif ev.kind == "latency":
+                self.broadcast_all(ev.value)
+            elif ev.kind == "idle":
+                self.broadcast_all(ev.value)
+            self._advance_processing_time(self.chain)
+
+        if not self._cancelled.is_set():
+            self.chain.finish()
+            self.chain.close()
+            self.broadcast_all(EndOfInput())

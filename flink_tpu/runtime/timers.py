@@ -1,0 +1,144 @@
+"""Internal timer service: per-key event/processing-time timers.
+
+Analog of the reference's InternalTimerServiceImpl
+(flink-streaming-java api/operators/InternalTimerServiceImpl.java:44,
+InternalTimeServiceManagerImpl.java:58): timers are (timestamp, key, namespace)
+triples, deduplicated, partitioned by key group so they snapshot/restore with
+keyed state and re-shard on rescale. Event-time timers fire when the operator's
+watermark advances past them; processing-time timers when wall-clock advances
+(driven by the task's step loop rather than a JVM timer thread).
+
+The generic host implementation is a binary heap + dedup set. The device
+window path doesn't use per-key timers at all — pane boundaries make firing a
+vectorized comparison (SURVEY.md §7 hard-parts: 'per-key timers at 10M keys').
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.keygroups import KeyGroupRange, assign_to_key_group
+from ..core.records import MIN_TIMESTAMP
+
+__all__ = ["Timer", "InternalTimerService", "TimerSerializationMixin"]
+
+
+@dataclass(frozen=True, order=True)
+class Timer:
+    timestamp: int
+    key: Any
+    namespace: Any = None
+
+
+class InternalTimerService:
+    """One named timer service per operator (reference: one per namespace
+    serializer); confined to the task thread."""
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
+                 on_event_time: Callable[[Timer], None],
+                 on_processing_time: Callable[[Timer], None]):
+        self.key_group_range = key_group_range
+        self.max_parallelism = max_parallelism
+        self._on_event_time = on_event_time
+        self._on_processing_time = on_processing_time
+        # heap entries are (ts, seq, kg, key, ns); seq breaks ties so keys
+        # and namespaces (possibly mutually non-comparable) are never compared
+        self._event_heap: list[tuple] = []
+        self._event_set: set[tuple[int, Any, Any]] = set()
+        self._proc_heap: list[tuple] = []
+        self._proc_set: set[tuple[int, Any, Any]] = set()
+        self._seq = 0
+        self.current_watermark = MIN_TIMESTAMP
+
+    # -- registration (row path; keyed by caller-provided key) -------------
+    def register_event_time_timer(self, key: Any, timestamp: int,
+                                  namespace: Any = None) -> None:
+        t = (int(timestamp), key, namespace)
+        if t not in self._event_set:
+            self._event_set.add(t)
+            kg = assign_to_key_group(key, self.max_parallelism)
+            self._seq += 1
+            heapq.heappush(self._event_heap,
+                           (int(timestamp), self._seq, kg, key, namespace))
+
+    def register_processing_time_timer(self, key: Any, timestamp: int,
+                                       namespace: Any = None) -> None:
+        t = (int(timestamp), key, namespace)
+        if t not in self._proc_set:
+            self._proc_set.add(t)
+            kg = assign_to_key_group(key, self.max_parallelism)
+            self._seq += 1
+            heapq.heappush(self._proc_heap,
+                           (int(timestamp), self._seq, kg, key, namespace))
+
+    def delete_event_time_timer(self, key: Any, timestamp: int,
+                                namespace: Any = None) -> None:
+        self._event_set.discard((int(timestamp), key, namespace))
+
+    def delete_processing_time_timer(self, key: Any, timestamp: int,
+                                     namespace: Any = None) -> None:
+        self._proc_set.discard((int(timestamp), key, namespace))
+
+    # -- firing ------------------------------------------------------------
+    def advance_watermark(self, watermark: int) -> None:
+        """Fire all event-time timers <= watermark (reference
+        InternalTimerServiceImpl.advanceWatermark)."""
+        self.current_watermark = watermark
+        while self._event_heap and self._event_heap[0][0] <= watermark:
+            ts, _seq, _kg, key, ns = heapq.heappop(self._event_heap)
+            ident = (ts, key, ns)
+            if ident in self._event_set:  # not deleted
+                self._event_set.discard(ident)
+                self._on_event_time(Timer(ts, key, ns))
+
+    def advance_processing_time(self, now_ms: int) -> None:
+        while self._proc_heap and self._proc_heap[0][0] <= now_ms:
+            ts, _seq, _kg, key, ns = heapq.heappop(self._proc_heap)
+            ident = (ts, key, ns)
+            if ident in self._proc_set:
+                self._proc_set.discard(ident)
+                self._on_processing_time(Timer(ts, key, ns))
+
+    def next_processing_time(self) -> Optional[int]:
+        while self._proc_heap:
+            ts, _seq, _kg, key, ns = self._proc_heap[0]
+            if (ts, key, ns) in self._proc_set:
+                return ts
+            heapq.heappop(self._proc_heap)
+        return None
+
+    # -- checkpointing: timers snapshot per key group ----------------------
+    def snapshot(self) -> dict:
+        def dump(heap, live):
+            per_kg: dict[int, list] = {}
+            for ts, _seq, kg, key, ns in heap:
+                if (ts, key, ns) in live:
+                    per_kg.setdefault(kg, []).append((ts, key, ns))
+            return per_kg
+
+        return {"event": dump(self._event_heap, self._event_set),
+                "proc": dump(self._proc_heap, self._proc_set),
+                "watermark": self.current_watermark}
+
+    def restore(self, snapshots: Iterable[dict]) -> None:
+        self._event_heap, self._event_set = [], set()
+        self._proc_heap, self._proc_set = [], set()
+        for snap in snapshots:
+            for kind in ("event", "proc"):
+                heap = self._event_heap if kind == "event" else self._proc_heap
+                live = self._event_set if kind == "event" else self._proc_set
+                for kg, timers in snap.get(kind, {}).items():
+                    kg = int(kg)
+                    if kg not in self.key_group_range:
+                        continue
+                    for ts, key, ns in timers:
+                        ident = (int(ts), key, ns)
+                        if ident not in live:
+                            live.add(ident)
+                            self._seq += 1
+                            heapq.heappush(heap,
+                                           (int(ts), self._seq, kg, key, ns))
+            self.current_watermark = max(self.current_watermark,
+                                         snap.get("watermark", MIN_TIMESTAMP))

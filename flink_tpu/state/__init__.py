@@ -1,0 +1,16 @@
+"""State backends: SPI + host heap backend + device-resident TPU backend.
+
+Maps the reference's state layer (SURVEY.md §2.4 state backends, §2.8 FRocksDB).
+"""
+
+from .backend import (  # noqa: F401
+    VOID_NAMESPACE, AggregatingState, KeyedStateBackend, ListState, MapState,
+    OperatorStateBackend, ReducingState, State, ValueState, create_backend,
+    register_backend,
+)
+from .descriptors import (  # noqa: F401
+    AggregatingStateDescriptor, ListStateDescriptor, MapStateDescriptor,
+    ReducingStateDescriptor, StateDescriptor, StateTtlConfig,
+    ValueStateDescriptor,
+)
+from .heap import HeapKeyedStateBackend  # noqa: F401

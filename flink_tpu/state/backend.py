@@ -1,0 +1,209 @@
+"""State backend SPI.
+
+Analog of the reference's StateBackend stack (flink-runtime state/:
+StateBackend.java:80, CheckpointableKeyedStateBackend.java:37,
+AbstractKeyedStateBackend, StateBackendLoader.java:50): a keyed backend owns
+all keyed state for one operator subtask's key-group range; an operator state
+backend owns non-keyed (e.g. source offset) state. Backends are chosen by name
+through a registry — the seam where the device-resident TPU backend plugs in
+alongside the host hashmap backend, mirroring how RocksDB is loaded by factory
+class in the reference.
+
+Keyed state is addressed by (key, namespace): the namespace is the window in
+windowed aggregations (reference's InternalKvState namespace concept).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from ..core.keygroups import KeyGroupRange, assign_to_key_group
+from .descriptors import StateDescriptor
+
+__all__ = [
+    "State", "ValueState", "ListState", "ReducingState", "AggregatingState",
+    "MapState", "KeyedStateBackend", "OperatorStateBackend",
+    "StateBackendFactory", "register_backend", "create_backend",
+    "VOID_NAMESPACE",
+]
+
+VOID_NAMESPACE = None
+
+
+class State:
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class ValueState(State):
+    def value(self) -> Any:
+        raise NotImplementedError
+
+    def update(self, value: Any) -> None:
+        raise NotImplementedError
+
+
+class ListState(State):
+    def get(self) -> Iterable[Any]:
+        raise NotImplementedError
+
+    def add(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def update(self, values: list) -> None:
+        raise NotImplementedError
+
+
+class ReducingState(State):
+    def get(self) -> Any:
+        raise NotImplementedError
+
+    def add(self, value: Any) -> None:
+        raise NotImplementedError
+
+
+class AggregatingState(State):
+    def get(self) -> Any:
+        raise NotImplementedError
+
+    def add(self, value: Any) -> None:
+        raise NotImplementedError
+
+
+class MapState(State):
+    def get(self, key: Any) -> Any:
+        raise NotImplementedError
+
+    def put(self, key: Any, value: Any) -> None:
+        raise NotImplementedError
+
+    def remove(self, key: Any) -> None:
+        raise NotImplementedError
+
+    def contains(self, key: Any) -> bool:
+        raise NotImplementedError
+
+    def items(self) -> Iterable[tuple]:
+        raise NotImplementedError
+
+
+class KeyedStateBackend:
+    """Owns keyed state for one key-group range (reference
+    CheckpointableKeyedStateBackend). Subtask-confined: no locking, matching
+    the mailbox-thread discipline."""
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+        self.key_group_range = key_group_range
+        self.max_parallelism = max_parallelism
+        self._current_key: Any = None
+        self._current_key_group: int = -1
+        self._current_namespace: Any = VOID_NAMESPACE
+
+    # -- current-key context (row path) -----------------------------------
+    def set_current_key(self, key: Any, key_group: Optional[int] = None) -> None:
+        self._current_key = key
+        self._current_key_group = (assign_to_key_group(key, self.max_parallelism)
+                                   if key_group is None else key_group)
+
+    def set_current_namespace(self, namespace: Any) -> None:
+        self._current_namespace = namespace
+
+    @property
+    def current_key(self) -> Any:
+        return self._current_key
+
+    # -- state handles -----------------------------------------------------
+    def get_partitioned_state(self, descriptor: StateDescriptor) -> State:
+        raise NotImplementedError
+
+    # -- introspection / iteration (savepoint reader, window cleanup) ------
+    def keys(self, state_name: str, namespace: Any = VOID_NAMESPACE) -> Iterable[Any]:
+        raise NotImplementedError
+
+    def namespaces(self, state_name: str) -> Iterable[Any]:
+        raise NotImplementedError
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot(self, checkpoint_id: int) -> dict:
+        """Serializable snapshot keyed by key group so restore can re-shard
+        (reference snapshot strategies + StateAssignmentOperation)."""
+        raise NotImplementedError
+
+    def restore(self, snapshots: Iterable[dict]) -> None:
+        """Restore from one or more snapshots, keeping only the key groups in
+        this backend's range (rescaling restore)."""
+        raise NotImplementedError
+
+    def dispose(self) -> None:
+        pass
+
+
+class OperatorStateBackend:
+    """Non-keyed per-subtask state with redistribution on rescale
+    (reference OperatorStateBackend: split/union list state)."""
+
+    def __init__(self):
+        self._lists: dict[str, list] = {}
+        self._modes: dict[str, str] = {}  # split | union
+
+    def get_list_state(self, name: str, mode: str = "split") -> list:
+        self._modes.setdefault(name, mode)
+        return self._lists.setdefault(name, [])
+
+    def update_list_state(self, name: str, values: list) -> None:
+        self._lists[name] = list(values)
+
+    def snapshot(self, checkpoint_id: int) -> dict:
+        return {"lists": {k: list(v) for k, v in self._lists.items()},
+                "modes": dict(self._modes)}
+
+    @staticmethod
+    def redistribute(snapshots: list[dict], new_parallelism: int) -> list[dict]:
+        """split: round-robin elements across new subtasks;
+        union: every subtask gets everything."""
+        names = set()
+        modes: dict[str, str] = {}
+        for s in snapshots:
+            names.update(s.get("lists", {}))
+            modes.update(s.get("modes", {}))
+        out = [{"lists": {n: [] for n in names}, "modes": modes}
+               for _ in range(new_parallelism)]
+        for name in names:
+            all_items = [x for s in snapshots for x in s.get("lists", {}).get(name, [])]
+            if modes.get(name) == "union":
+                for o in out:
+                    o["lists"][name] = list(all_items)
+            else:
+                for i, item in enumerate(all_items):
+                    out[i % new_parallelism]["lists"][name].append(item)
+        return out
+
+    def restore(self, snapshot: dict) -> None:
+        self._lists = {k: list(v) for k, v in snapshot.get("lists", {}).items()}
+        self._modes = dict(snapshot.get("modes", {}))
+
+
+# ---------------------------------------------------------------------------
+# Backend registry (reference StateBackendLoader.loadStateBackendFromConfig)
+# ---------------------------------------------------------------------------
+
+StateBackendFactory = Callable[..., KeyedStateBackend]
+_BACKENDS: dict[str, StateBackendFactory] = {}
+
+
+def register_backend(name: str, factory: StateBackendFactory) -> None:
+    _BACKENDS[name] = factory
+
+
+def create_backend(name: str, key_group_range: KeyGroupRange,
+                   max_parallelism: int, **kwargs) -> KeyedStateBackend:
+    if name not in _BACKENDS:
+        if ":" in name:  # fully-qualified "module:attr" factory, plugin-style
+            mod, attr = name.split(":", 1)
+            import importlib
+            factory = getattr(importlib.import_module(mod), attr)
+            return factory(key_group_range, max_parallelism, **kwargs)
+        raise ValueError(
+            f"Unknown state backend {name!r}; known: {sorted(_BACKENDS)}")
+    return _BACKENDS[name](key_group_range, max_parallelism, **kwargs)

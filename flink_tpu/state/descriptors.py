@@ -1,0 +1,84 @@
+"""State descriptors: named, typed handles to keyed state.
+
+Analog of flink-core's state descriptor family
+(api/common/state/: ValueStateDescriptor, ListStateDescriptor,
+ReducingStateDescriptor, AggregatingStateDescriptor, MapStateDescriptor).
+A descriptor identifies a state in the backend by name and prescribes how
+values fold (for reducing/aggregating state the backend may lower the fold to
+a device segment-reduce — see state/tpu_backend.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.functions import AggregateFunction, ReduceFunction
+
+__all__ = [
+    "StateDescriptor", "ValueStateDescriptor", "ListStateDescriptor",
+    "ReducingStateDescriptor", "AggregatingStateDescriptor",
+    "MapStateDescriptor", "StateTtlConfig",
+]
+
+
+@dataclass(frozen=True)
+class StateTtlConfig:
+    """Relaxed TTL (reference StateTtlConfig): entries expire ttl seconds
+    after last update; cleanup happens lazily on access and on snapshot."""
+
+    ttl: float
+    update_on_read: bool = False
+
+
+@dataclass(frozen=True)
+class StateDescriptor:
+    name: str
+    kind: str  # value | list | reducing | aggregating | map
+    default: Any = None
+    ttl: Optional[StateTtlConfig] = None
+
+    def __post_init__(self):
+        if self.kind not in ("value", "list", "reducing", "aggregating", "map"):
+            raise ValueError(f"Unknown state kind {self.kind!r}")
+
+
+def ValueStateDescriptor(name: str, default: Any = None,
+                         ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "value", default, ttl)
+
+
+def ListStateDescriptor(name: str,
+                        ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "list", None, ttl)
+
+
+def MapStateDescriptor(name: str,
+                       ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
+    return StateDescriptor(name, "map", None, ttl)
+
+
+@dataclass(frozen=True)
+class ReducingStateDescriptor(StateDescriptor):
+    reduce_function: ReduceFunction = None  # type: ignore[assignment]
+
+    def __init__(self, name: str, reduce_function: ReduceFunction,
+                 ttl: Optional[StateTtlConfig] = None):
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "kind", "reducing")
+        object.__setattr__(self, "default", None)
+        object.__setattr__(self, "ttl", ttl)
+        object.__setattr__(self, "reduce_function", reduce_function)
+
+
+@dataclass(frozen=True)
+class AggregatingStateDescriptor(StateDescriptor):
+    aggregate_function: AggregateFunction = None  # type: ignore[assignment]
+
+    def __init__(self, name: str, aggregate_function: AggregateFunction,
+                 ttl: Optional[StateTtlConfig] = None):
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "kind", "aggregating")
+        object.__setattr__(self, "default", None)
+        object.__setattr__(self, "ttl", ttl)
+        object.__setattr__(self, "aggregate_function", aggregate_function)

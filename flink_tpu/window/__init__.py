@@ -1,0 +1,12 @@
+"""Windowing: assigners, triggers, evictors (SURVEY.md §2.5 WindowOperator row)."""
+
+from .assigners import (  # noqa: F401
+    EventTimeSessionWindows, GlobalWindow, GlobalWindows,
+    SlidingEventTimeWindows, SlidingProcessingTimeWindows, TimeWindow,
+    TumblingEventTimeWindows, TumblingProcessingTimeWindows, WindowAssigner,
+)
+from .triggers import (  # noqa: F401
+    ContinuousEventTimeTrigger, CountEvictor, CountTrigger, EventTimeTrigger,
+    Evictor, NeverTrigger, ProcessingTimeTrigger, PurgingTrigger, TimeEvictor,
+    Trigger, TriggerContext, TriggerResult,
+)

@@ -1,0 +1,202 @@
+"""Window assigners.
+
+Analog of flink-streaming-java's assigners
+(api/windowing/assigners/: TumblingEventTimeWindows, SlidingEventTimeWindows,
+EventTimeSessionWindows, GlobalWindows) and of the table runtime's slice
+assigners (flink-table-runtime operators/window/slicing/SliceAssigners.java).
+
+Batch-first: every non-merging assigner can vectorize assignment over a
+timestamp column (``assign_batch``) — for sliding windows this produces the
+*pane/slice* index per record (one non-overlapping slice per slide period),
+which is what lets the device path aggregate each record exactly once and
+merge panes at fire time (the reference's slice-shared optimization,
+SURVEY.md §5.7b).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..core.records import MAX_TIMESTAMP
+
+__all__ = [
+    "TimeWindow", "GlobalWindow", "WindowAssigner", "TumblingEventTimeWindows",
+    "TumblingProcessingTimeWindows", "SlidingEventTimeWindows",
+    "SlidingProcessingTimeWindows", "EventTimeSessionWindows", "GlobalWindows",
+]
+
+
+@dataclass(frozen=True, order=True)
+class TimeWindow:
+    """[start, end) window; max_timestamp is end-1 (reference TimeWindow)."""
+
+    start: int
+    end: int
+
+    @property
+    def max_timestamp(self) -> int:
+        return self.end - 1
+
+    def intersects(self, other: "TimeWindow") -> bool:
+        return self.start <= other.max_timestamp and other.start <= self.max_timestamp
+
+    def cover(self, other: "TimeWindow") -> "TimeWindow":
+        return TimeWindow(min(self.start, other.start), max(self.end, other.end))
+
+
+@dataclass(frozen=True)
+class GlobalWindow:
+    @property
+    def max_timestamp(self) -> int:
+        return MAX_TIMESTAMP
+
+
+def _window_start(ts: np.ndarray, size: int, offset: int) -> np.ndarray:
+    """reference TimeWindow.getWindowStartWithOffset: ts - (ts - offset) mod size
+    (floor-mod, correct for negative timestamps)."""
+    return ts - np.mod(ts - offset, size)
+
+
+class WindowAssigner:
+    is_event_time: bool = True
+    is_merging: bool = False
+
+    def assign_windows(self, timestamp: int) -> Iterable:
+        raise NotImplementedError
+
+    def assign_batch(self, timestamps: np.ndarray) -> Optional[np.ndarray]:
+        """Vectorized: pane-start int64 per record, or None if not paneable."""
+        return None
+
+    @property
+    def pane_size(self) -> Optional[int]:
+        """Slice width in ms when the assigner decomposes into panes."""
+        return None
+
+    def windows_for_pane(self, pane_start: int) -> Iterable[TimeWindow]:
+        """All windows a pane contributes to (1 for tumbling, size/slide for
+        sliding) — the fire-time merge set."""
+        raise NotImplementedError
+
+    def default_trigger(self):
+        from .triggers import EventTimeTrigger, ProcessingTimeTrigger
+        return EventTimeTrigger() if self.is_event_time else ProcessingTimeTrigger()
+
+
+@dataclass(frozen=True)
+class TumblingEventTimeWindows(WindowAssigner):
+    size: int
+    offset: int = 0
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingEventTimeWindows":
+        return TumblingEventTimeWindows(size_ms, offset_ms)
+
+    def assign_windows(self, timestamp: int):
+        start = int(_window_start(np.int64(timestamp), self.size, self.offset))
+        return [TimeWindow(start, start + self.size)]
+
+    def assign_batch(self, timestamps: np.ndarray) -> np.ndarray:
+        return _window_start(timestamps, self.size, self.offset)
+
+    @property
+    def pane_size(self) -> int:
+        return self.size
+
+    def windows_for_pane(self, pane_start: int):
+        return [TimeWindow(pane_start, pane_start + self.size)]
+
+
+class TumblingProcessingTimeWindows(TumblingEventTimeWindows):
+    is_event_time = False
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingProcessingTimeWindows":
+        return TumblingProcessingTimeWindows(size_ms, offset_ms)
+
+
+@dataclass(frozen=True)
+class SlidingEventTimeWindows(WindowAssigner):
+    size: int
+    slide: int
+    offset: int = 0
+
+    def __post_init__(self):
+        if self.size % self.slide != 0:
+            # Panes require size to be a multiple of slide; reference supports
+            # arbitrary size/slide via per-record multi-assign — we keep that
+            # row path but lose the pane optimization.
+            pass
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int,
+           offset_ms: int = 0) -> "SlidingEventTimeWindows":
+        return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
+
+    def assign_windows(self, timestamp: int):
+        last_start = int(_window_start(np.int64(timestamp), self.slide, self.offset))
+        out = []
+        start = last_start
+        while start > timestamp - self.size:
+            out.append(TimeWindow(start, start + self.size))
+            start -= self.slide
+        return out
+
+    def assign_batch(self, timestamps: np.ndarray) -> Optional[np.ndarray]:
+        if self.size % self.slide != 0:
+            return None
+        return _window_start(timestamps, self.slide, self.offset)
+
+    @property
+    def pane_size(self) -> Optional[int]:
+        return self.slide if self.size % self.slide == 0 else None
+
+    def windows_for_pane(self, pane_start: int):
+        n = self.size // self.slide
+        return [TimeWindow(pane_start - i * self.slide,
+                           pane_start - i * self.slide + self.size)
+                for i in range(n)]
+
+
+class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
+    is_event_time = False
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int,
+           offset_ms: int = 0) -> "SlidingProcessingTimeWindows":
+        return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+@dataclass(frozen=True)
+class EventTimeSessionWindows(WindowAssigner):
+    """Merging session windows (reference EventTimeSessionWindows + the
+    MergingWindowSet handled in the window operator)."""
+
+    gap: int
+    is_merging = True
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "EventTimeSessionWindows":
+        return EventTimeSessionWindows(gap_ms)
+
+    def assign_windows(self, timestamp: int):
+        return [TimeWindow(timestamp, timestamp + self.gap)]
+
+
+@dataclass(frozen=True)
+class GlobalWindows(WindowAssigner):
+    is_event_time = False
+
+    @staticmethod
+    def create() -> "GlobalWindows":
+        return GlobalWindows()
+
+    def assign_windows(self, timestamp: int):
+        return [GlobalWindow()]
+
+    def default_trigger(self):
+        from .triggers import NeverTrigger
+        return NeverTrigger()

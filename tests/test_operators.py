@@ -1,0 +1,100 @@
+"""Operator tests on the deterministic harness (the
+OneInputStreamOperatorTestHarness analog — SURVEY.md §4 tier 2)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core import Schema
+from flink_tpu.core.functions import ProcessFunction, as_filter, as_flat_map, \
+    as_map
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime import OneInputOperatorTestHarness, Timer
+from flink_tpu.runtime.operators import (
+    FilterOperator, FlatMapOperator, KeyedProcessOperator, MapOperator,
+)
+from flink_tpu.state import ValueStateDescriptor
+
+
+class TestSimpleOperators:
+    def test_map(self):
+        h = OneInputOperatorTestHarness(MapOperator(as_map(lambda x: x * 2)))
+        h.process_elements([1, 2, 3])
+        assert h.get_output() == [2, 4, 6]
+
+    def test_map_preserves_timestamps(self):
+        h = OneInputOperatorTestHarness(MapOperator(as_map(lambda x: x + 1)))
+        h.process_elements([1], [555])
+        h.close()
+        assert list(h.output.batches[0].timestamps) == [555]
+
+    def test_filter(self):
+        h = OneInputOperatorTestHarness(
+            FilterOperator(as_filter(lambda x: x % 2 == 0)))
+        h.process_elements([1, 2, 3, 4])
+        assert h.get_output() == [2, 4]
+
+    def test_flatmap(self):
+        h = OneInputOperatorTestHarness(
+            FlatMapOperator(as_flat_map(lambda s: s.split())))
+        h.process_elements(["a b", "c"])
+        assert h.get_output() == ["a", "b", "c"]
+
+    def test_watermark_forwarding(self):
+        h = OneInputOperatorTestHarness(MapOperator(as_map(lambda x: x)))
+        h.process_watermark(100)
+        h.process_watermark(200)
+        assert h.get_watermarks() == [100, 200]
+
+
+class CountPerKey(ProcessFunction):
+    """Counts per key; emits (key, count) on every element; timer at
+    count==3 emits a 'done' marker."""
+
+    def open(self, ctx):
+        self.ctx = ctx
+        self.desc = ValueStateDescriptor("count", default=0)
+
+    def process_element(self, value, ctx, out):
+        state = self.ctx.get_state(self.desc)
+        c = state.value() + 1
+        state.update(c)
+        out.collect((ctx.current_key, c))
+        if c == 3:
+            ctx.timer_service.register_event_time_timer(
+                (ctx.timestamp or 0) + 10)
+
+    def on_timer(self, timestamp, ctx, out):
+        out.collect((ctx.current_key, "done"))
+
+
+class TestKeyedProcessOperator:
+    def _harness(self):
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+        return OneInputOperatorTestHarness(
+            KeyedProcessOperator(CountPerKey(), extract),
+            schema=Schema([("k", object), ("v", np.int64)]))
+
+    def test_keyed_state_and_timers(self):
+        h = self._harness()
+        h.process_elements([("a", 1), ("b", 1), ("a", 2)], [1, 2, 3])
+        assert h.get_output() == [("a", 1), ("b", 1), ("a", 2)]
+        h.process_element(("a", 3), 5)  # count->3, timer at 15
+        h.clear_output()
+        h.process_watermark(20)
+        assert h.get_output() == [("a", "done")]
+
+    def test_snapshot_restore(self):
+        h = self._harness()
+        h.process_elements([("a", 1), ("a", 2)], [1, 2])
+        snap = h.snapshot()
+
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+
+        h2 = OneInputOperatorTestHarness.restored(
+            lambda: KeyedProcessOperator(CountPerKey(), extract),
+            {"keyed": snap["keyed"]},
+            schema=Schema([("k", object), ("v", np.int64)]))
+        h2.process_element(("a", 3), 3)
+        assert h2.get_output() == [("a", 3)]  # continued from restored count 2

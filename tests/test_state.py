@@ -1,0 +1,156 @@
+"""State backend tests (heap backend, operator state, TTL, rescaling)."""
+
+import time
+
+import pytest
+
+from flink_tpu.core import KeyGroupRange
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.state import (
+    AggregatingStateDescriptor, HeapKeyedStateBackend, ListStateDescriptor,
+    MapStateDescriptor, OperatorStateBackend, ReducingStateDescriptor,
+    StateTtlConfig, ValueStateDescriptor, create_backend,
+)
+from flink_tpu.core.functions import AggregateFunction, as_reduce
+
+
+def full_range_backend(maxp=128):
+    return HeapKeyedStateBackend(KeyGroupRange(0, maxp - 1), maxp)
+
+
+class TestHeapBackend:
+    def test_value_state(self):
+        b = full_range_backend()
+        desc = ValueStateDescriptor("v", default=0)
+        b.set_current_key("a")
+        s = b.get_partitioned_state(desc)
+        assert s.value() == 0
+        s.update(5)
+        assert s.value() == 5
+        b.set_current_key("b")
+        assert s.value() == 0  # per-key isolation
+        s.update(7)
+        b.set_current_key("a")
+        assert s.value() == 5
+        s.clear()
+        assert s.value() == 0
+
+    def test_namespace_isolation(self):
+        b = full_range_backend()
+        desc = ValueStateDescriptor("v")
+        b.set_current_key("k")
+        s = b.get_partitioned_state(desc)
+        b.set_current_namespace("w1")
+        s.update(1)
+        b.set_current_namespace("w2")
+        s.update(2)
+        b.set_current_namespace("w1")
+        assert s.value() == 1
+
+    def test_list_reducing_aggregating_map(self):
+        b = full_range_backend()
+        b.set_current_key("k")
+        ls = b.get_partitioned_state(ListStateDescriptor("l"))
+        ls.add(1); ls.add(2)
+        assert list(ls.get()) == [1, 2]
+
+        rs = b.get_partitioned_state(
+            ReducingStateDescriptor("r", as_reduce(lambda a, c: a + c)))
+        rs.add(3); rs.add(4)
+        assert rs.get() == 7
+
+        class Avg(AggregateFunction):
+            def create_accumulator(self): return (0, 0)
+            def add(self, v, acc): return (acc[0] + v, acc[1] + 1)
+            def merge(self, a, b): return (a[0] + b[0], a[1] + b[1])
+            def get_result(self, acc): return acc[0] / acc[1]
+
+        ags = b.get_partitioned_state(AggregatingStateDescriptor("a", Avg()))
+        ags.add(10); ags.add(20)
+        assert ags.get() == 15.0
+
+        ms = b.get_partitioned_state(MapStateDescriptor("m"))
+        ms.put("x", 1)
+        assert ms.contains("x") and ms.get("x") == 1
+        ms.remove("x")
+        assert not ms.contains("x")
+
+    def test_snapshot_restore_roundtrip(self):
+        b = full_range_backend()
+        desc = ValueStateDescriptor("v")
+        for k in ["a", "b", "c"]:
+            b.set_current_key(k)
+            b.get_partitioned_state(desc).update(k.upper())
+        snap = b.snapshot(1)
+        b2 = full_range_backend()
+        b2.restore([snap])
+        b2.set_current_key("b")
+        assert b2.get_partitioned_state(desc).value() == "B"
+
+    def test_rescaling_restore_splits_by_key_group(self):
+        """One backend's snapshot restored into two half-range backends:
+        every key lands in exactly one (the StateAssignmentOperation
+        property)."""
+        maxp = 128
+        b = full_range_backend(maxp)
+        desc = ValueStateDescriptor("v")
+        keys = [f"key-{i}" for i in range(100)]
+        for k in keys:
+            b.set_current_key(k)
+            b.get_partitioned_state(desc).update(k)
+        snap = b.snapshot(1)
+
+        b1 = HeapKeyedStateBackend(KeyGroupRange(0, 63), maxp)
+        b2 = HeapKeyedStateBackend(KeyGroupRange(64, 127), maxp)
+        b1.restore([snap]); b2.restore([snap])
+        for k in keys:
+            kg = assign_to_key_group(k, maxp)
+            owner = b1 if kg <= 63 else b2
+            other = b2 if kg <= 63 else b1
+            owner.set_current_key(k)
+            assert owner.get_partitioned_state(desc).value() == k
+            assert len(list(other.keys("v"))) + len(list(owner.keys("v"))) == 100
+
+    def test_ttl_expiry(self):
+        b = full_range_backend()
+        desc = ValueStateDescriptor("v", ttl=StateTtlConfig(ttl=0.05))
+        b.set_current_key("k")
+        s = b.get_partitioned_state(desc)
+        s.update(1)
+        assert s.value() == 1
+        time.sleep(0.06)
+        assert s.value() is None  # expired lazily
+        s.update(2)
+        snap = b.snapshot(1)
+        # non-expired entries survive snapshots
+        assert snap["states"]["v"]
+
+    def test_registry(self):
+        b = create_backend("hashmap", KeyGroupRange(0, 127), 128)
+        assert isinstance(b, HeapKeyedStateBackend)
+        with pytest.raises(ValueError):
+            create_backend("nope", KeyGroupRange(0, 127), 128)
+
+
+class TestOperatorState:
+    def test_split_redistribute(self):
+        backends = [OperatorStateBackend() for _ in range(2)]
+        backends[0].get_list_state("offsets").extend([1, 2])
+        backends[1].get_list_state("offsets").extend([3])
+        snaps = [b.snapshot(1) for b in backends]
+        redist = OperatorStateBackend.redistribute(snaps, 3)
+        items = []
+        for r in redist:
+            nb = OperatorStateBackend()
+            nb.restore(r)
+            items.extend(nb.get_list_state("offsets"))
+        assert sorted(items) == [1, 2, 3]
+
+    def test_union_redistribute(self):
+        b = OperatorStateBackend()
+        b.get_list_state("all", mode="union").extend(["x", "y"])
+        redist = OperatorStateBackend.redistribute([b.snapshot(1)], 2)
+        for r in redist:
+            nb = OperatorStateBackend()
+            nb.restore(r)
+            assert sorted(nb.get_list_state("all")) == ["x", "y"]

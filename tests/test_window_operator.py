@@ -1,0 +1,187 @@
+"""WindowOperator semantics on the harness: mirrors the reference's
+WindowOperatorTest coverage (tumbling/sliding/session, lateness, triggers,
+evictors)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core import Schema
+from flink_tpu.core.functions import AggregateFunction
+from flink_tpu.runtime import OneInputOperatorTestHarness
+from flink_tpu.runtime.operators import WindowOperator
+from flink_tpu.runtime.operators.window import LATE_DATA_TAG
+from flink_tpu.window import (
+    CountEvictor, CountTrigger, EventTimeSessionWindows, GlobalWindows,
+    PurgingTrigger, SlidingEventTimeWindows, TimeWindow,
+    TumblingEventTimeWindows, TumblingProcessingTimeWindows,
+)
+
+SCHEMA = Schema([("k", object), ("v", np.int64)])
+
+
+class SumAgg(AggregateFunction):
+    def create_accumulator(self): return 0
+    def add(self, value, acc): return acc + value[1]
+    def merge(self, a, b): return a + b
+    def get_result(self, acc): return acc
+
+
+def harness(assigner, **kw) -> OneInputOperatorTestHarness:
+    def extract(batch):
+        return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+    op = WindowOperator(assigner, extract, aggregate=SumAgg(), **kw)
+    return OneInputOperatorTestHarness(op, schema=SCHEMA)
+
+
+class TestTumbling:
+    def test_fire_on_watermark(self):
+        h = harness(TumblingEventTimeWindows.of(10))
+        h.process_elements([("a", 1), ("a", 2), ("b", 5)], [1, 5, 3])
+        assert h.get_output() == []  # nothing fired yet
+        h.process_watermark(9)       # max_ts of [0,10) is 9
+        assert sorted(h.get_output()) == [("a", 3), ("b", 5)]
+
+    def test_multiple_windows(self):
+        h = harness(TumblingEventTimeWindows.of(10))
+        h.process_elements([("a", 1), ("a", 2)], [1, 15])
+        h.process_watermark(100)
+        assert h.get_output() == [("a", 1), ("a", 2)]
+
+    def test_state_cleared_after_fire(self):
+        h = harness(TumblingEventTimeWindows.of(10))
+        h.process_element(("a", 1), 1)
+        h.process_watermark(9)
+        h.clear_output()
+        # same window receives nothing further; late element dropped (no
+        # lateness allowed)
+        h.process_element(("a", 9), 2)
+        h.process_watermark(30)
+        assert h.get_output() == []
+
+    def test_allowed_lateness_refires(self):
+        h = harness(TumblingEventTimeWindows.of(10), allowed_lateness=10)
+        h.process_element(("a", 1), 1)
+        h.process_watermark(9)
+        assert h.get_output() == [("a", 1)]
+        h.clear_output()
+        h.process_element(("a", 2), 5)  # late but within lateness
+        assert h.get_output() == [("a", 3)]  # immediate re-fire, accumulated
+        h.process_watermark(19)  # cleanup at 9+10
+        h.clear_output()
+        h.process_element(("a", 7), 5)  # beyond lateness: dropped
+        h.process_watermark(50)
+        assert h.get_output() == []
+
+    def test_late_data_side_output(self):
+        h = harness(TumblingEventTimeWindows.of(10), emit_late_data=True)
+        h.process_element(("a", 1), 1)
+        h.process_watermark(20)
+        h.process_element(("a", 9), 2)  # too late
+        assert h.get_side_output(LATE_DATA_TAG) == [("a", 9)]
+
+    def test_window_fn_with_bounds(self):
+        def wf(key, window, result):
+            yield (key, window.start, window.end, result)
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+        op = WindowOperator(TumblingEventTimeWindows.of(10), extract,
+                            aggregate=SumAgg(), window_fn=wf)
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        h.process_element(("a", 1), 12)
+        h.process_watermark(100)
+        assert h.get_output() == [("a", 10, 20, 1)]
+
+    def test_output_timestamp_is_window_max(self):
+        h = harness(TumblingEventTimeWindows.of(10))
+        h.process_element(("a", 1), 3)
+        h.process_watermark(100)
+        assert list(h.output.batches[0].timestamps) == [9]
+
+
+class TestSliding:
+    def test_each_element_in_size_over_slide_windows(self):
+        h = harness(SlidingEventTimeWindows.of(10, 5))
+        h.process_element(("a", 1), 7)  # windows [0,10) and [5,15)
+        h.process_watermark(100)
+        assert h.get_output() == [("a", 1), ("a", 1)]
+
+    def test_sliding_sums(self):
+        h = harness(SlidingEventTimeWindows.of(10, 5))
+        h.process_elements([("a", 1), ("a", 2), ("a", 4)], [2, 7, 12])
+        h.process_watermark(100)
+        # [-5,5):1  [0,10):3  [5,15):6  [10,20):4
+        assert h.get_output() == [("a", 1), ("a", 3), ("a", 6), ("a", 4)]
+
+
+class TestSession:
+    def test_merge(self):
+        h = harness(EventTimeSessionWindows.with_gap(10))
+        h.process_elements([("a", 1), ("a", 2)], [0, 5])   # one session
+        h.process_element(("a", 4), 30)                     # second session
+        h.process_watermark(100)
+        assert h.get_output() == [("a", 3), ("a", 4)]
+
+    def test_bridge_merge(self):
+        h = harness(EventTimeSessionWindows.with_gap(10))
+        h.process_element(("a", 1), 0)
+        h.process_element(("a", 2), 18)   # separate session
+        h.process_element(("a", 4), 9)    # bridges both -> merge all
+        h.process_watermark(100)
+        assert h.get_output() == [("a", 7)]
+
+    def test_keys_do_not_merge_across(self):
+        h = harness(EventTimeSessionWindows.with_gap(10))
+        h.process_elements([("a", 1), ("b", 2)], [0, 5])
+        h.process_watermark(100)
+        assert sorted(h.get_output()) == [("a", 1), ("b", 2)]
+
+
+class TestTriggersEvictors:
+    def test_count_trigger_purging(self):
+        h = harness(GlobalWindows.create(),
+                    trigger=PurgingTrigger.of(CountTrigger.of(2)))
+        h.process_elements([("a", 1), ("a", 2)], [1, 2])
+        assert h.get_output() == [("a", 3)]
+        h.clear_output()
+        h.process_elements([("a", 5), ("a", 5)], [3, 4])
+        assert h.get_output() == [("a", 10)]  # purged: fresh accumulation
+
+    def test_count_evictor(self):
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+        op = WindowOperator(
+            GlobalWindows.create(), extract, aggregate=SumAgg(),
+            trigger=CountTrigger.of(3), evictor=CountEvictor.of(2))
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        h.process_elements([("a", 1), ("a", 2), ("a", 3)], [1, 2, 3])
+        # evictor keeps last 2 -> 2+3
+        assert h.get_output() == [("a", 5)]
+
+
+class TestProcessingTime:
+    def test_processing_time_window(self):
+        h = harness(TumblingProcessingTimeWindows.of(1000))
+        h.set_processing_time(500)
+        h.process_element(("a", 1))
+        h.process_element(("a", 2))
+        assert h.get_output() == []
+        h.set_processing_time(1000)  # window [0,1000) fires at 999
+        assert h.get_output() == [("a", 3)]
+
+
+class TestSnapshotRestore:
+    def test_window_contents_survive_restore(self):
+        h = harness(TumblingEventTimeWindows.of(10))
+        h.process_elements([("a", 1), ("b", 2)], [1, 2])
+        snap = h.snapshot()
+
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+
+        h2 = OneInputOperatorTestHarness.restored(
+            lambda: WindowOperator(TumblingEventTimeWindows.of(10), extract,
+                                   aggregate=SumAgg()),
+            {"keyed": snap["keyed"]}, schema=SCHEMA)
+        h2.process_element(("a", 10), 3)
+        h2.process_watermark(9)
+        assert sorted(h2.get_output()) == [("a", 11), ("b", 2)]
