@@ -424,6 +424,9 @@ class WindowedStream:
         return self._builtin_agg("count", None, name)
 
     def _builtin_agg(self, kind: str, field, name: str) -> DataStream:
+        device = self._try_device_agg(kind, field, name)
+        if device is not None:
+            return device
         import operator as _op
 
         class _Builtin(AggregateFunction):
@@ -476,6 +479,62 @@ class WindowedStream:
                 return acc
 
         return self._build(name, aggregate=_Builtin())
+
+
+    def _try_device_agg(self, kind: str, field, name: str
+                        ) -> Optional[DataStream]:
+        """Planner rule: lower a builtin window aggregate to the device
+        slice-window operator when the configured backend is 'tpu', the key
+        is a numeric column, the assigner decomposes into panes, and no
+        custom trigger/evictor/lateness is attached. Falls back to the host
+        WindowOperator otherwise — outputs are identical (parity-tested)."""
+        from ..core.config import StateOptions
+        cfg = self.keyed.env.config
+        if (cfg.get(StateOptions.BACKEND) != "tpu"
+                or not isinstance(self.keyed.key_spec, str)
+                or not isinstance(field, (str, type(None)))
+                or self.assigner.pane_size is None
+                or self._trigger is not None or self._evictor is not None
+                or self._lateness != 0 or self._late_tag is not None):
+            return None
+        from ..runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        assigner = self.assigner
+        key_col = self.keyed.key_spec
+        capacity = cfg.get(StateOptions.TPU_CAPACITY) or (1 << 16)
+        spec = AggSpec(kind, field, out_name="result")
+
+        def factory():
+            return DeviceWindowAggOperator(
+                assigner, key_col, [spec], capacity=capacity,
+                emit_window_bounds=False, name=name)
+
+        par = 1 if self._all else None
+        return self.keyed._one_input(name, factory, parallelism=par,
+                                     key_extractor=self.keyed.key_extractor)
+
+    def device_aggregate(self, aggs, capacity: int = 1 << 16,
+                         ring_size: int = 64,
+                         emit_window_bounds: bool = True,
+                         name: str = "DeviceWindowAgg") -> DataStream:
+        """Explicit device window aggregation with multiple AggSpecs
+        (key, [window_start, window_end], *agg columns)."""
+        from ..runtime.operators.device_window import DeviceWindowAggOperator
+        if not isinstance(self.keyed.key_spec, str):
+            raise ValueError("device aggregation needs a column key")
+        assigner = self.assigner
+        key_col = self.keyed.key_spec
+
+        def factory():
+            return DeviceWindowAggOperator(
+                assigner, key_col, aggs, capacity=capacity,
+                ring_size=ring_size, emit_window_bounds=emit_window_bounds,
+                name=name)
+
+        par = 1 if self._all else None
+        return self.keyed._one_input(name, factory, parallelism=par,
+                                     key_extractor=self.keyed.key_extractor)
 
 
 class ConnectedStreams:

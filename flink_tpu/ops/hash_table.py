@@ -1,0 +1,139 @@
+"""Device-resident open-addressing hash table: int64 key -> dense slot.
+
+The core of the TPU keyed-state backend (SURVEY.md §7 step 3, the
+FRocksDB-replacement): keyed state lives in dense device arrays indexed by
+slot; this table maps unbounded keys onto those static-shape arrays entirely
+on device, so the per-batch hot path never touches the host.
+
+Algorithm: linear probing over a power-of-two table with a vectorized
+parallel insert. Each iteration, every unresolved record reads its probe
+slot; records that see EMPTY race to claim it with a single ``scatter-min``
+(deterministic winner = smallest key); records that see a foreign key advance
+their probe. Claims only target slots read as EMPTY in the same iteration, so
+occupied slots are never corrupted; duplicate keys follow identical probe
+sequences and resolve to the same slot. Bounded probe count returns an ``ok``
+mask instead of looping forever (host rehashes on overflow).
+
+Keys are int64 with EMPTY = int64 max as the sentinel (a real key equal to
+the sentinel is remapped by the caller — see state/tpu_backend.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_X64_READY = False
+
+
+def ensure_x64() -> None:
+    """Keyed state uses full 64-bit keys on device (XLA emulates i64 on TPU
+    with i32 pairs — fine for the compare/scatter ops the table needs).
+    Flipped at first *use* of the device state path, not at import, so merely
+    importing the library never changes a user program's default dtypes."""
+    global _X64_READY
+    if not _X64_READY:
+        jax.config.update("jax_enable_x64", True)
+        _X64_READY = True
+
+__all__ = ["EMPTY_KEY", "make_table", "lookup", "lookup_or_insert",
+           "hash_keys_device", "ensure_x64", "MAX_PROBES"]
+
+EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
+MAX_PROBES = 128
+
+
+def make_table(capacity: int) -> jax.Array:
+    """capacity must be a power of two."""
+    ensure_x64()
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity {capacity} not a power of two")
+    return jnp.full((capacity,), EMPTY_KEY, dtype=jnp.int64)
+
+
+def hash_keys_device(keys: jax.Array) -> jax.Array:
+    """Murmur-style finalizer over int64 keys -> uint32 hash, matching the
+    host path's spread (keygroups.murmur_mix over Long.hashCode-folded keys)
+    closely enough for probing (exact parity is only required for key-group
+    routing, which happens before this table)."""
+    u = keys.astype(jnp.uint64)
+    h = (u ^ (u >> 32)).astype(jnp.uint32)
+    h = h * jnp.uint32(0xCC9E2D51)
+    h = (h << 15) | (h >> 17)
+    h = h * jnp.uint32(0x1B873593)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    return h
+
+
+@jax.jit
+def lookup(table_keys: jax.Array, keys: jax.Array) -> jax.Array:
+    """Find slots for keys; -1 where absent. Vectorized bounded probing."""
+    cap = table_keys.shape[0]
+    mask = jnp.uint32(cap - 1)
+    h0 = hash_keys_device(keys) & mask
+
+    def body(state):
+        probe, slot, done = state
+        idx = (h0 + probe) & mask
+        entry = table_keys[idx.astype(jnp.int32)]
+        found = entry == keys
+        empty = entry == EMPTY_KEY
+        slot = jnp.where(~done & found, idx.astype(jnp.int32), slot)
+        done = done | found | empty  # empty => key absent
+        probe = jnp.where(done, probe, probe + 1)
+        return probe, slot, done
+
+    def cond(state):
+        probe, _slot, done = state
+        return ((~done) & (probe < MAX_PROBES)).any()
+
+    n = keys.shape[0]
+    init = (jnp.zeros(n, jnp.uint32), jnp.full(n, -1, jnp.int32),
+            jnp.zeros(n, bool))
+    _, slot, _ = jax.lax.while_loop(cond, body, init)
+    return slot
+
+
+@jax.jit
+def lookup_or_insert(table_keys: jax.Array, keys: jax.Array
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Find-or-claim slots for a batch of keys.
+
+    Returns (new_table_keys, slots int32, ok bool). Records that exhaust
+    MAX_PROBES report ok=False with slot=-1 (host should rehash bigger).
+    """
+    cap = table_keys.shape[0]
+    mask = jnp.uint32(cap - 1)
+    h0 = hash_keys_device(keys) & mask
+    n = keys.shape[0]
+
+    def body(state):
+        table, probe, slot, done = state
+        idx = ((h0 + probe) & mask).astype(jnp.int32)
+        entry = table[idx]
+        found = entry == keys
+        empty = entry == EMPTY_KEY
+        # claim: losers of the scatter-min re-read next iteration
+        claim_idx = jnp.where(~done & empty, idx, jnp.int32(0))
+        claim_val = jnp.where(~done & empty, keys, EMPTY_KEY)
+        table = table.at[claim_idx].min(claim_val)
+        entry2 = table[idx]
+        won = ~done & empty & (entry2 == keys)
+        slot = jnp.where(~done & (found | won), idx, slot)
+        done = done | found | won
+        probe = jnp.where(done, probe, probe + 1)
+        return table, probe, slot, done
+
+    def cond(state):
+        _table, probe, _slot, done = state
+        return ((~done) & (probe < MAX_PROBES)).any()
+
+    init = (table_keys, jnp.zeros(n, jnp.uint32),
+            jnp.full(n, -1, jnp.int32), jnp.zeros(n, bool))
+    table, _probe, slot, done = jax.lax.while_loop(cond, body, init)
+    return table, slot, done

@@ -1,0 +1,97 @@
+"""Segment-reduce kernels: the device aggregation primitives.
+
+These are the lowering targets for the framework's aggregate contract
+(core AggregateFunction add/merge — reference AggregateFunction.java:114) and
+for the window/group aggregations (reference WindowOperator + table-runtime
+GroupAggFunction): each micro-batch folds into per-(pane, slot) accumulators
+with ONE scatter op per aggregate, and window fire merges pane accumulators
+with one reduction — no per-record work anywhere.
+
+All functions are jax-traceable and shard_map-compatible (accumulators are
+per-shard; cross-shard merge is the caller's psum/all_gather).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["scatter_fold", "pane_window_merge", "AGG_INITS", "AGG_FOLDS",
+           "make_accumulator", "segment_topk"]
+
+
+def _scatter_add(acc, idx, vals):
+    return acc.at[idx].add(vals)
+
+
+def _scatter_min(acc, idx, vals):
+    return acc.at[idx].min(vals)
+
+
+def _scatter_max(acc, idx, vals):
+    return acc.at[idx].max(vals)
+
+
+#: kind -> (identity element factory, scatter fold, pane merge)
+AGG_INITS = {
+    "sum": lambda dtype: jnp.array(0, dtype),
+    "count": lambda dtype: jnp.array(0, dtype),
+    "min": lambda dtype: jnp.array(jnp.finfo(dtype).max
+                                   if jnp.issubdtype(dtype, jnp.floating)
+                                   else jnp.iinfo(dtype).max, dtype),
+    "max": lambda dtype: jnp.array(jnp.finfo(dtype).min
+                                   if jnp.issubdtype(dtype, jnp.floating)
+                                   else jnp.iinfo(dtype).min, dtype),
+}
+
+AGG_FOLDS = {
+    "sum": _scatter_add,
+    "count": _scatter_add,
+    "min": _scatter_min,
+    "max": _scatter_max,
+}
+
+_MERGES = {
+    "sum": jnp.sum,
+    "count": jnp.sum,
+    "min": lambda x, axis: jnp.min(x, axis=axis),
+    "max": lambda x, axis: jnp.max(x, axis=axis),
+}
+
+
+def make_accumulator(kind: str, shape: tuple[int, ...], dtype) -> jax.Array:
+    return jnp.full(shape, AGG_INITS[kind](dtype), dtype=dtype)
+
+
+def scatter_fold(kind: str, acc: jax.Array, flat_idx: jax.Array,
+                 values: jax.Array, valid: jax.Array) -> jax.Array:
+    """Fold a batch into a flat accumulator: acc[flat_idx] op= values,
+    masked by ``valid`` (invalid rows fold the identity into slot 0)."""
+    identity = AGG_INITS[kind](acc.dtype)
+    idx = jnp.where(valid, flat_idx, 0)
+    vals = jnp.where(valid, values.astype(acc.dtype), identity)
+    return AGG_FOLDS[kind](acc, idx, vals)
+
+
+def pane_window_merge(kind: str, acc: jax.Array,
+                      pane_rows: jax.Array) -> jax.Array:
+    """Merge selected pane rows of a [ring, capacity] accumulator into one
+    [capacity] result — the slice-shared window fire
+    (reference SliceSharedWindowAggProcessor)."""
+    return _MERGES[kind](acc[pane_rows], 0)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def segment_topk(values: jax.Array, valid: jax.Array, k: int
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Top-k over a slot-indexed value array (Nexmark Q5 'hot items'):
+    returns (topk values, topk slot indices)."""
+    neg_inf = (jnp.finfo(values.dtype).min
+               if jnp.issubdtype(values.dtype, jnp.floating)
+               else jnp.iinfo(values.dtype).min)
+    masked = jnp.where(valid, values, neg_inf)
+    return jax.lax.top_k(masked, k)

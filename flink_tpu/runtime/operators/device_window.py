@@ -1,0 +1,284 @@
+"""Device slice-window operator: the north-star TPU execution path.
+
+Replaces the reference's per-record window hot loop
+(WindowOperator.processElement:278 + slice-shared table path
+SliceSharedWindowAggProcessor) with whole-batch device execution:
+
+* each micro-batch runs ONE compiled step — hash keys -> device hash-table
+  slot resolution -> pane index -> one scatter-fold per aggregate into a
+  [ring, capacity] pane accumulator (the slice decomposition of §5.7b:
+  sliding windows never aggregate a record twice);
+* there are NO per-key timers: a window ending at pane boundary ``p_end``
+  fires when the (host-scalar) watermark passes ``p_end*pane - 1``, and the
+  fire is one pane-merge reduction over all keys in the subtask's key-group
+  range (BASELINE north star), after which the retired pane's ring row is
+  zeroed for reuse;
+* under shard_map the identical step runs per device on its key-group shard
+  (keys are partitioned, so keyed aggregation needs no collective; global
+  post-aggregations psum — see parallel/).
+
+Late records (pane already fired) are dropped and counted, matching the host
+operator at allowed_lateness=0; use the host WindowOperator for lateness
+re-firing or merging windows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.elements import Watermark
+from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
+from ...ops.hash_table import EMPTY_KEY
+from ...ops.segment_ops import pane_window_merge
+from ...state.tpu_backend import TpuKeyedStateBackend
+from ...window.assigners import WindowAssigner
+from .base import OneInputOperator, OperatorContext, Output
+
+__all__ = ["DeviceWindowAggOperator", "AggSpec"]
+
+
+class AggSpec:
+    """One aggregate column: kind in sum|count|min|max|avg over field."""
+
+    def __init__(self, kind: str, field: Optional[str] = None,
+                 out_name: Optional[str] = None, dtype=jnp.float32):
+        if kind not in ("sum", "count", "min", "max", "avg"):
+            raise ValueError(f"unsupported device aggregate {kind}")
+        self.kind = kind
+        self.field = field
+        self.out_name = out_name or (f"{kind}_{field}" if field else kind)
+        self.dtype = dtype
+
+
+class DeviceWindowAggOperator(OneInputOperator):
+    def __init__(self, assigner: WindowAssigner, key_column: str,
+                 aggs: Sequence[AggSpec],
+                 capacity: int = 1 << 16,
+                 ring_size: int = 64,
+                 emit_window_bounds: bool = True,
+                 name: str = "DeviceWindowAgg"):
+        super().__init__(name)
+        pane = assigner.pane_size
+        if pane is None:
+            raise ValueError(
+                "Device window operator needs a pane-decomposable assigner "
+                "(tumbling, or sliding with size % slide == 0)")
+        self._assigner = assigner
+        self._pane = int(pane)
+        self._offset = int(getattr(assigner, "offset", 0))
+        size = getattr(assigner, "size", self._pane)
+        self._window_panes = int(size) // self._pane  # W panes per window
+        self._ring = int(ring_size)
+        if self._ring < self._window_panes + 1:
+            raise ValueError("ring_size must exceed panes per window")
+        self._key_column = key_column
+        self._aggs = list(aggs)
+        self._capacity = capacity
+        self._emit_bounds = emit_window_bounds
+
+        self._backend: Optional[TpuKeyedStateBackend] = None
+        # host control-plane scalars: windows ending at pane boundary p_end
+        # for all p_end < _fired_boundary have fired; panes <
+        # _fired_boundary - W are retired (ring rows reusable, records late)
+        self._fired_boundary: Optional[int] = None
+        self._min_seen_pane: Optional[int] = None
+        self._max_seen_pane: Optional[int] = None
+        self._late_dropped = 0
+        self._out_schema: Optional[Schema] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def setup(self, ctx: OperatorContext, output: Output) -> None:
+        super().setup(ctx, output)
+        self._backend = TpuKeyedStateBackend(
+            ctx.key_group_range, ctx.max_parallelism, capacity=self._capacity)
+        self._backend.register_array_state("__count__", "count", jnp.int64,
+                                           ring=self._ring)
+        self._registered = False
+
+    def _register_aggs(self, schema: Schema) -> None:
+        """Accumulator dtypes follow the input columns (sum over int64
+        accumulates int64, matching the host operator's Python arithmetic);
+        avg always accumulates float."""
+        for a in self._aggs:
+            if a.field is not None and a.field in schema:
+                col_dtype = np.dtype(schema.field(a.field).dtype)
+                a.dtype = (jnp.float32 if a.kind == "avg"
+                           else jnp.dtype(col_dtype))
+            if a.kind == "avg":
+                self._backend.register_array_state(
+                    f"{a.out_name}.sum", "sum", a.dtype, ring=self._ring)
+            elif a.kind != "count":
+                self._backend.register_array_state(
+                    a.out_name, a.kind, a.dtype, ring=self._ring)
+        self._registered = True
+
+    def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
+        if keyed_snapshots:
+            self._backend.restore([s["backend"] for s in keyed_snapshots])
+            metas = [s["meta"] for s in keyed_snapshots]
+            fires = [m["fired_boundary"] for m in metas
+                     if m.get("fired_boundary") is not None]
+            seens = [m["max_seen_pane"] for m in metas
+                     if m["max_seen_pane"] is not None]
+            mins = [m["min_seen_pane"] for m in metas
+                    if m.get("min_seen_pane") is not None]
+            self._fired_boundary = min(fires) if fires else None
+            self._max_seen_pane = max(seens) if seens else None
+            self._min_seen_pane = min(mins) if mins else None
+            self.current_watermark = max(m["watermark"] for m in metas)
+
+    # -- data path ---------------------------------------------------------
+    def process_batch(self, batch: RecordBatch) -> None:
+        n = batch.n
+        if n == 0:
+            return
+        if not self._registered:
+            key_dtype = batch.schema.field(self._key_column).dtype
+            if key_dtype is object or not np.issubdtype(np.dtype(key_dtype),
+                                                        np.integer):
+                raise TypeError(
+                    f"device window aggregation needs an integer key column; "
+                    f"{self._key_column!r} is {key_dtype} — use the hashmap "
+                    "state backend for float/string keys")
+            self._register_aggs(batch.schema)
+        keys = batch.column(self._key_column).astype(np.int64)
+        panes = ((batch.timestamps - self._offset) // self._pane).astype(
+            np.int64)
+
+        # late = every window containing the pane has fired (its ring row
+        # may already be retired/reused)
+        if self._fired_boundary is not None:
+            first_open = self._fired_boundary - self._window_panes
+            late = panes < first_open
+            n_late = int(late.sum())
+            if n_late:
+                self._late_dropped += n_late
+                keep = ~late
+                keys, panes = keys[keep], panes[keep]
+                batch = batch.filter(keep)
+                if batch.n == 0:
+                    return
+        max_pane = int(panes.max())
+        min_pane = int(panes.min())
+        self._max_seen_pane = (max_pane if self._max_seen_pane is None
+                               else max(self._max_seen_pane, max_pane))
+        self._min_seen_pane = (min_pane if self._min_seen_pane is None
+                               else min(self._min_seen_pane, min_pane))
+        # ring overflow check: two open panes must never share a ring row
+        low = (self._fired_boundary - self._window_panes
+               if self._fired_boundary is not None else self._min_seen_pane)
+        if max_pane - low >= self._ring:
+            raise RuntimeError(
+                f"pane ring overflow: open span [{low},{max_pane}] exceeds "
+                f"ring {self._ring}; increase ring_size or reduce "
+                "watermark lag")
+
+        slots = self._backend.slots_for_batch(keys)
+        ring_idx = jnp.asarray(panes % self._ring)
+        valid = slots >= 0
+        self._backend.fold_batch("__count__", slots,
+                                 jnp.ones(batch.n, jnp.int64), valid,
+                                 ring_idx=ring_idx)
+        for a in self._aggs:
+            if a.kind == "count":
+                continue
+            col = jnp.asarray(batch.column(a.field))
+            name = f"{a.out_name}.sum" if a.kind == "avg" else a.out_name
+            self._backend.fold_batch(name, slots, col, valid,
+                                     ring_idx=ring_idx)
+
+    # -- firing ------------------------------------------------------------
+    def process_watermark(self, watermark: Watermark) -> None:
+        self.current_watermark = watermark.timestamp
+        # a window ending at pane boundary p_end fires when
+        # wm >= p_end*pane + offset - 1
+        wm_pane_end = (watermark.timestamp - self._offset + 1) // self._pane
+        if self._max_seen_pane is not None:
+            # windows ending at or below min_seen contain no data; never
+            # reach below that (their ring rows may alias future panes)
+            start = self._min_seen_pane + 1
+            if self._fired_boundary is not None:
+                start = max(start, self._fired_boundary)
+            last = min(wm_pane_end, self._max_seen_pane + self._window_panes)
+            for p_end in range(start, last + 1):
+                self._fire(p_end)
+        # the boundary tracks the watermark even when no data has arrived
+        # yet or no window fired, so records behind the watermark are
+        # dropped as late exactly like the host operator
+        if self._fired_boundary is None or wm_pane_end + 1 > self._fired_boundary:
+            self._fired_boundary = wm_pane_end + 1
+        self.output.emit_watermark(watermark)
+
+    def _fire(self, p_end: int) -> None:
+        W = self._window_panes
+        # never read panes below min_seen: they hold no data and their ring
+        # rows may be occupied by live FUTURE panes (row aliasing)
+        first = max(p_end - W, self._min_seen_pane)
+        if first >= p_end:
+            return
+        pane_rows = np.array([(p % self._ring) for p in range(first, p_end)],
+                             dtype=np.int32)
+        rows_d = jnp.asarray(pane_rows)
+        count = pane_window_merge("count", self._backend.get_array("__count__"),
+                                  rows_d)
+        emit_mask = (self._backend.occupied_mask()) & (count > 0)
+        results = {}
+        for a in self._aggs:
+            if a.kind == "count":
+                results[a.out_name] = count
+            elif a.kind == "avg":
+                s = pane_window_merge(
+                    "sum", self._backend.get_array(f"{a.out_name}.sum"), rows_d)
+                results[a.out_name] = s / jnp.maximum(count, 1).astype(s.dtype)
+            else:
+                results[a.out_name] = pane_window_merge(
+                    a.kind, self._backend.get_array(a.out_name), rows_d)
+
+        self._emit(p_end, emit_mask, results)
+
+        # retire the oldest pane of this window: no future window needs it
+        # (skip panes below min_seen — their ring rows belong to live panes)
+        if p_end - W >= self._min_seen_pane:
+            self._backend.reset_ring_row((p_end - W) % self._ring)
+
+    def _emit(self, p_end: int, emit_mask: jax.Array,
+              results: dict[str, jax.Array]) -> None:
+        mask = np.asarray(jax.device_get(emit_mask))
+        if not mask.any():
+            return
+        idx = np.flatnonzero(mask)
+        table = np.asarray(jax.device_get(self._backend.table))
+        keys = table[idx]
+        start = (p_end - self._window_panes) * self._pane + self._offset
+        end = p_end * self._pane + self._offset
+        cols: dict[str, np.ndarray] = {self._key_column: keys}
+        fields: list[tuple[str, Any]] = [(self._key_column, np.int64)]
+        if self._emit_bounds:
+            cols["window_start"] = np.full(len(idx), start, np.int64)
+            cols["window_end"] = np.full(len(idx), end, np.int64)
+            fields += [("window_start", np.int64), ("window_end", np.int64)]
+        for name, arr in results.items():
+            vals = np.asarray(jax.device_get(arr))[idx]
+            cols[name] = vals
+            fields.append((name, vals.dtype.type))
+        schema = Schema(fields)
+        ts = np.full(len(idx), end - 1, np.int64)
+        self.output.emit(RecordBatch(schema, cols, ts))
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        return {"keyed": {
+            "backend": self._backend.snapshot(checkpoint_id),
+            "meta": {"fired_boundary": self._fired_boundary,
+                     "min_seen_pane": self._min_seen_pane,
+                     "max_seen_pane": self._max_seen_pane,
+                     "watermark": self.current_watermark}}}
+
+    @property
+    def late_dropped(self) -> int:
+        return self._late_dropped

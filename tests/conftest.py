@@ -1,20 +1,28 @@
 """Test config: force an 8-device virtual CPU platform so multi-chip sharding
 paths run without TPU hardware (the MiniCluster-analog of the reference's
-single-JVM multi-TaskExecutor testing, SURVEY.md §4 tier 3)."""
+single-JVM multi-TaskExecutor testing, SURVEY.md §4 tier 3).
+
+NOTE: this environment pre-registers the 'axon' TPU plugin via sitecustomize
+and exports JAX_PLATFORMS=axon, so env setdefault is NOT enough — we override
+the env var AND the jax config explicitly (explicit config.update wins over
+whatever the plugin registration selected)."""
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU backend is initialized (no jax arrays exist yet
+# at conftest import; plugin *registration* in sitecustomize is harmless).
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture
 def eight_device_mesh():
-    import jax
     from jax.sharding import Mesh
     import numpy as np
     devs = np.array(jax.devices("cpu")[:8])

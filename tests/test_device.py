@@ -1,0 +1,336 @@
+"""Device-path tests: hash table kernels, TPU state backend, device window
+operator parity with the host WindowOperator (runs on the virtual CPU
+platform; same code path compiles for TPU)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from flink_tpu.core import KeyGroupRange, Schema  # noqa: E402
+from flink_tpu.ops.hash_table import (  # noqa: E402
+    EMPTY_KEY, lookup, lookup_or_insert, make_table,
+)
+from flink_tpu.ops.segment_ops import (  # noqa: E402
+    make_accumulator, pane_window_merge, scatter_fold, segment_topk,
+)
+from flink_tpu.state.tpu_backend import TpuKeyedStateBackend  # noqa: E402
+
+
+class TestHashTable:
+    def test_insert_and_lookup(self):
+        t = make_table(64)
+        keys = jnp.array([5, 17, 5, 99, 17], dtype=jnp.int64)
+        t, slots, ok = lookup_or_insert(t, keys)
+        s = np.asarray(slots)
+        assert bool(np.asarray(ok).all())
+        assert s[0] == s[2] and s[1] == s[4]  # duplicates share slots
+        assert len({s[0], s[1], s[3]}) == 3   # distinct keys distinct slots
+        # lookup finds the same slots
+        s2 = np.asarray(lookup(t, jnp.array([99, 5], dtype=jnp.int64)))
+        assert s2[0] == s[3] and s2[1] == s[0]
+
+    def test_lookup_missing(self):
+        t = make_table(64)
+        t, _, _ = lookup_or_insert(t, jnp.array([1, 2], dtype=jnp.int64))
+        assert np.asarray(lookup(t, jnp.array([42], dtype=jnp.int64)))[0] == -1
+
+    def test_collision_heavy(self):
+        """Many keys into a small table: all inserted, slots unique."""
+        t = make_table(256)
+        keys = jnp.arange(128, dtype=jnp.int64) * 256  # same low bits
+        t, slots, ok = lookup_or_insert(t, keys)
+        s = np.asarray(slots)
+        assert bool(np.asarray(ok).all())
+        assert len(set(s.tolist())) == 128
+
+    def test_incremental_batches(self):
+        t = make_table(1024)
+        rng = np.random.default_rng(0)
+        all_keys = rng.choice(10_000, size=500, replace=False).astype(np.int64)
+        slots_by_key = {}
+        for i in range(0, 500, 100):
+            batch = jnp.asarray(all_keys[i:i + 100])
+            t, slots, ok = lookup_or_insert(t, batch)
+            assert bool(np.asarray(ok).all())
+            for k, s in zip(all_keys[i:i + 100], np.asarray(slots)):
+                slots_by_key[int(k)] = int(s)
+        # re-lookup everything: stable slots
+        s2 = np.asarray(lookup(t, jnp.asarray(all_keys)))
+        for k, s in zip(all_keys, s2):
+            assert slots_by_key[int(k)] == int(s)
+
+
+class TestSegmentOps:
+    def test_scatter_fold_kinds(self):
+        acc = make_accumulator("sum", (8,), jnp.float32)
+        idx = jnp.array([1, 1, 3], jnp.int32)
+        vals = jnp.array([2.0, 3.0, 7.0])
+        valid = jnp.array([True, True, False])
+        out = np.asarray(scatter_fold("sum", acc, idx, vals, valid))
+        assert out[1] == 5.0 and out[3] == 0.0
+
+        accm = make_accumulator("min", (4,), jnp.int64)
+        out = np.asarray(scatter_fold(
+            "min", accm, jnp.array([0, 0], jnp.int32),
+            jnp.array([7, 3], jnp.int64), jnp.array([True, True])))
+        assert out[0] == 3
+
+    def test_pane_window_merge(self):
+        acc = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = np.asarray(pane_window_merge("sum", acc, jnp.array([0, 2])))
+        assert out.tolist() == [8.0, 10.0, 12.0, 14.0]
+
+    def test_topk(self):
+        vals = jnp.array([5.0, 1.0, 9.0, 3.0])
+        valid = jnp.array([True, True, False, True])
+        v, i = segment_topk(vals, valid, 2)
+        assert np.asarray(v).tolist() == [5.0, 3.0]
+        assert np.asarray(i).tolist() == [0, 3]
+
+
+class TestTpuBackend:
+    def test_fold_and_rehash_growth(self):
+        b = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128, capacity=64)
+        b.register_array_state("acc", "sum", jnp.float32)
+        rng = np.random.default_rng(1)
+        keys = rng.choice(100_000, size=200, replace=False).astype(np.int64)
+        for i in range(0, 200, 50):
+            k = keys[i:i + 50]
+            slots = b.slots_for_batch(k)
+            b.fold_batch("acc", slots, jnp.ones(len(k), jnp.float32),
+                         slots >= 0)
+        assert b.capacity >= 256  # grew past initial 64
+        # every key has exactly 1.0 despite rehashes
+        slots = np.asarray(jax.device_get(
+            b.slots_for_batch(keys)))
+        acc = np.asarray(jax.device_get(b.get_array("acc")))
+        assert np.allclose(acc[slots], 1.0)
+
+    def test_snapshot_restore_rescale(self):
+        b = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128, capacity=128)
+        b.register_array_state("acc", "sum", jnp.float32)
+        keys = np.arange(50, dtype=np.int64)
+        slots = b.slots_for_batch(keys)
+        b.fold_batch("acc", slots, jnp.asarray(keys.astype(np.float32)),
+                     slots >= 0)
+        snap = b.snapshot(1)
+
+        b1 = TpuKeyedStateBackend(KeyGroupRange(0, 63), 128, capacity=128)
+        b2 = TpuKeyedStateBackend(KeyGroupRange(64, 127), 128, capacity=128)
+        b1.restore([snap])
+        b2.restore([snap])
+        k1 = set(b1.keys("acc"))
+        k2 = set(b2.keys("acc"))
+        assert k1.isdisjoint(k2)
+        assert k1 | k2 == set(range(50))
+        # values preserved
+        got = {}
+        for bb in (b1, b2):
+            t = np.asarray(jax.device_get(bb.table))
+            occ = np.flatnonzero(t != EMPTY_KEY)
+            acc = np.asarray(jax.device_get(bb.get_array("acc")))
+            for s in occ:
+                got[int(t[s])] = float(acc[s])
+        assert got == {int(k): float(k) for k in keys}
+
+
+def _host_window_result(elements, ts, window, kind="sum"):
+    """Run the host WindowOperator for parity reference."""
+    from flink_tpu.core.functions import AggregateFunction
+    from flink_tpu.runtime import OneInputOperatorTestHarness
+    from flink_tpu.runtime.operators import WindowOperator
+
+    class Agg(AggregateFunction):
+        def create_accumulator(self): return 0
+        def add(self, v, acc): return acc + v[1]
+        def merge(self, a, b): return a + b
+        def get_result(self, acc): return acc
+
+    def extract(batch):
+        return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+
+    op = WindowOperator(window, extract, aggregate=Agg())
+    h = OneInputOperatorTestHarness(
+        op, schema=Schema([("key", np.int64), ("v", np.int64)]))
+    h.process_elements(elements, ts)
+    h.process_watermark(10**9)
+    return sorted((int(k), int(v)) for k, v in h.get_output())
+
+
+class TestDeviceWindowOperator:
+    def _device_result(self, elements, ts, assigner, watermarks=None):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        op = DeviceWindowAggOperator(
+            assigner, "key", [AggSpec("sum", "v", out_name="result")],
+            capacity=1 << 10, emit_window_bounds=False)
+        h = OneInputOperatorTestHarness(
+            op, schema=Schema([("key", np.int64), ("v", np.int64)]))
+        if watermarks is None:
+            h.process_elements(elements, ts)
+            h.process_watermark(10**9)
+        else:
+            for step in watermarks:
+                if step[0] == "batch":
+                    h.process_elements(step[1], step[2])
+                else:
+                    h.process_watermark(step[1])
+        return h, sorted((int(k), int(v)) for k, v in h.get_output())
+
+    def test_tumbling_parity_with_host(self):
+        from flink_tpu.window import TumblingEventTimeWindows
+        rng = np.random.default_rng(2)
+        n = 500
+        elements = [(int(k), int(v)) for k, v in
+                    zip(rng.integers(0, 20, n), rng.integers(1, 10, n))]
+        ts = sorted(rng.integers(0, 10_000, n).tolist())
+        w = TumblingEventTimeWindows.of(1000)
+        _h, device = self._device_result(elements, ts, w)
+        host = _host_window_result(elements, ts, w)
+        assert device == host
+
+    def test_sliding_parity_with_host(self):
+        from flink_tpu.window import SlidingEventTimeWindows
+        rng = np.random.default_rng(3)
+        n = 300
+        elements = [(int(k), int(v)) for k, v in
+                    zip(rng.integers(0, 10, n), rng.integers(1, 5, n))]
+        ts = sorted(rng.integers(0, 5_000, n).tolist())
+        w = SlidingEventTimeWindows.of(1000, 250)
+        _h, device = self._device_result(elements, ts, w)
+        host = _host_window_result(elements, ts, w)
+        assert device == host
+
+    def test_incremental_watermarks_fire_incrementally(self):
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(100)
+        h, out = self._device_result(
+            None, None, w,
+            watermarks=[
+                ("batch", [(1, 5), (2, 7)], [10, 20]),
+                ("wm", 99),                       # fires window [0,100)
+                ("batch", [(1, 3)], [150]),
+                ("wm", 199),                      # fires window [100,200)
+            ])
+        assert out == [(1, 3), (1, 5), (2, 7)]
+
+    def test_late_drop_counted(self):
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(100)
+        h, out = self._device_result(
+            None, None, w,
+            watermarks=[
+                ("batch", [(1, 5)], [10]),
+                ("wm", 299),
+                ("batch", [(1, 9)], [20]),   # late: window fired
+                ("wm", 399),
+            ])
+        assert out == [(1, 5)]
+        assert h.operator.late_dropped == 1
+
+    def test_snapshot_restore_continues(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(100)
+
+        def make_op():
+            return DeviceWindowAggOperator(
+                w, "key", [AggSpec("sum", "v", out_name="result")],
+                capacity=1 << 10, emit_window_bounds=False)
+
+        schema = Schema([("key", np.int64), ("v", np.int64)])
+        h = OneInputOperatorTestHarness(make_op(), schema=schema)
+        h.process_elements([(1, 5), (2, 7)], [10, 20])
+        snap = h.snapshot()
+
+        h2 = OneInputOperatorTestHarness.restored(
+            lambda: make_op(), snap, schema=schema)
+        h2.process_elements([(1, 3)], [30])
+        h2.process_watermark(99)
+        assert sorted((int(k), int(v)) for k, v in h2.get_output()) == \
+            [(1, 8), (2, 7)]
+
+    def test_pipeline_auto_device_selection(self):
+        """env with tpu backend: WindowedStream.sum lowers to device op."""
+        from flink_tpu.api import StreamExecutionEnvironment
+        from flink_tpu.core import Schema as S, WatermarkStrategy
+        from flink_tpu.window import TumblingEventTimeWindows
+        env = StreamExecutionEnvironment.get_execution_environment()
+        env.set_state_backend("tpu")
+        schema = S([("key", np.int64), ("v", np.int64), ("ts", np.int64)])
+
+        def gen(idx):
+            return {"key": idx % 7, "v": np.ones_like(idx), "ts": idx * 10}
+
+        ws = WatermarkStrategy.for_monotonous_timestamps() \
+            .with_timestamp_column("ts")
+        out = (env.datagen(gen, schema, count=700, timestamp_column="ts",
+                           watermark_strategy=ws)
+               .key_by("key")
+               .window(TumblingEventTimeWindows.of(1000))
+               .sum("v")
+               .execute_and_collect())
+        total = sum(int(v) for _k, v in out)
+        assert total == 700
+
+
+class TestDeviceWindowRegressions:
+    """Regressions from review: ring aliasing, pre-data lateness, empty
+    restore, non-integer keys."""
+
+    SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+    def _op(self, assigner, **kw):
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        return DeviceWindowAggOperator(
+            assigner, "k", [AggSpec("sum", "v", out_name="result")],
+            emit_window_bounds=False, **kw)
+
+    def test_sparse_panes_no_ring_aliasing(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import SlidingEventTimeWindows
+        op = self._op(SlidingEventTimeWindows.of(4000, 1000), ring_size=64)
+        h = OneInputOperatorTestHarness(op, schema=self.SCHEMA)
+        h.process_elements([(1, 10)], [500])
+        h.process_elements([(1, 100)], [61500])  # pane 61 aliases row of pane -3
+        h.process_watermark(10**9)
+        out = sorted(int(v) for _k, v in h.get_output())
+        assert out == [10, 10, 10, 10, 100, 100, 100, 100]
+
+    def test_pre_data_watermark_drops_late(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        op = self._op(TumblingEventTimeWindows.of(100))
+        h = OneInputOperatorTestHarness(op, schema=self.SCHEMA)
+        h.process_watermark(999)
+        h.process_elements([(1, 5)], [10])
+        h.process_watermark(1999)
+        assert h.get_output() == []
+        assert op.late_dropped == 1
+
+    def test_empty_snapshot_restore(self):
+        from flink_tpu.core import KeyGroupRange
+        b = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128, capacity=64)
+        b.register_array_state("a", "sum", jnp.float32)
+        snap = b.snapshot(1)
+        b2 = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128, capacity=64)
+        b2.restore([snap])  # must not raise
+        assert b2.num_keys == 0
+
+    def test_non_integer_key_rejected(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        op = self._op(TumblingEventTimeWindows.of(100))
+        h = OneInputOperatorTestHarness(
+            op, schema=Schema([("k", np.float64), ("v", np.int64)]))
+        with pytest.raises(TypeError, match="integer key column"):
+            h.process_elements([(2.3, 1)], [10])
