@@ -73,6 +73,11 @@ class DataStream:
 
     def map(self, fn, name: str = "Map", out_schema: Optional[Schema] = None,
             parallelism: Optional[int] = None) -> "DataStream":
+        """Per-row transform. When the function returns tuples with the SAME
+        arity as the input, output columns inherit the input's column names
+        (so key_by("col") keeps working across enrichment-style maps); a map
+        that reorders/replaces fields should pass ``out_schema`` to name the
+        outputs correctly."""
         mf = as_map(fn)
         from ..runtime.operators.simple import MapOperator
         return self._one_input(

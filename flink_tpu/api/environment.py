@@ -121,13 +121,22 @@ class StreamExecutionEnvironment:
 
     def execute(self, job_name: str = "flink-tpu-job",
                 timeout: Optional[float] = 120.0,
-                metrics_registry=None):
+                metrics_registry=None, recover: bool = False):
         """Compile and run locally, blocking until completion (bounded
-        sources) — reference execute():2309."""
-        from ..cluster.local import run_job
+        sources) — reference execute():2309. With ``recover=True`` the job
+        runs under a JobSupervisor that restarts from the latest completed
+        checkpoint on task failure (requires enable_checkpointing)."""
         jg = self.get_job_graph(job_name)
-        self.last_job = run_job(jg, self.config, timeout=timeout,
-                                metrics_registry=metrics_registry)
+        if recover:
+            from ..cluster.scheduler import JobSupervisor
+            supervisor = JobSupervisor(jg, self.config,
+                                       metrics_registry=metrics_registry)
+            self.last_job = supervisor.run(timeout)
+            self.last_job.supervisor = supervisor
+        else:
+            from ..cluster.local import run_job
+            self.last_job = run_job(jg, self.config, timeout=timeout,
+                                    metrics_registry=metrics_registry)
         # a fresh env per execute is the common pattern; clear so the same
         # env can be reused for a new pipeline
         self._transformations = []

@@ -1,0 +1,92 @@
+"""Checkpoint storage: where completed snapshots live.
+
+Analog of the reference's CheckpointStorage
+(flink-runtime state/filesystem/FsCheckpointStorageAccess.java:44 and
+JobManagerCheckpointStorage): in-memory for tests, filesystem directory
+layout ``<dir>/chk-<id>/metadata`` for durability. Snapshots are
+host-serialized (device state was already DMA'd to numpy by the backends'
+snapshot()).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = ["CompletedCheckpoint", "CheckpointStorage", "MemoryCheckpointStorage",
+           "FsCheckpointStorage"]
+
+
+@dataclass
+class CompletedCheckpoint:
+    checkpoint_id: int
+    timestamp: float
+    # task_id -> task snapshot ({"reader":..., "chain": {...}})
+    task_snapshots: dict[str, dict]
+    is_savepoint: bool = False
+    external_path: Optional[str] = None
+    # topology at snapshot time, for rescaling restore
+    vertex_parallelism: dict[str, int] = field(default_factory=dict)
+
+
+class CheckpointStorage:
+    def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
+        raise NotImplementedError
+
+    def discard(self, checkpoint: CompletedCheckpoint) -> None:
+        pass
+
+    def load(self, path_or_id: Any) -> CompletedCheckpoint:
+        raise NotImplementedError
+
+
+class MemoryCheckpointStorage(CheckpointStorage):
+    def __init__(self):
+        self._store: dict[int, CompletedCheckpoint] = {}
+
+    def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
+        self._store[checkpoint.checkpoint_id] = checkpoint
+        return checkpoint
+
+    def discard(self, checkpoint: CompletedCheckpoint) -> None:
+        self._store.pop(checkpoint.checkpoint_id, None)
+
+    def load(self, checkpoint_id: int) -> CompletedCheckpoint:
+        return self._store[checkpoint_id]
+
+
+class FsCheckpointStorage(CheckpointStorage):
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, checkpoint: CompletedCheckpoint) -> str:
+        prefix = "sp" if checkpoint.is_savepoint else "chk"
+        return os.path.join(self.directory, f"{prefix}-{checkpoint.checkpoint_id}")
+
+    def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
+        d = self._path(checkpoint)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "_metadata.part")
+        with open(tmp, "wb") as f:
+            pickle.dump(checkpoint, f, protocol=pickle.HIGHEST_PROTOCOL)
+        final = os.path.join(d, "_metadata")
+        os.replace(tmp, final)  # atomic publish
+        checkpoint.external_path = d
+        return checkpoint
+
+    def discard(self, checkpoint: CompletedCheckpoint) -> None:
+        if checkpoint.is_savepoint:
+            return  # savepoints are user-owned (reference semantics)
+        d = self._path(checkpoint)
+        shutil.rmtree(d, ignore_errors=True)
+
+    def load(self, path: str) -> CompletedCheckpoint:
+        meta = path if path.endswith("_metadata") else os.path.join(path,
+                                                                    "_metadata")
+        with open(meta, "rb") as f:
+            return pickle.load(f)

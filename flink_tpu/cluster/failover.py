@@ -1,0 +1,125 @@
+"""Restart backoff strategies.
+
+Analog of the reference's RestartBackoffTimeStrategy family
+(flink-runtime executiongraph/failover/: FixedDelayRestartBackoffTimeStrategy,
+ExponentialDelayRestartBackoffTimeStrategy:38, FailureRateRestartBackoffTime-
+Strategy, NoRestartBackoffTimeStrategy), selected through config exactly like
+RestartStrategyOptions.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..core.config import Configuration, RuntimeOptions
+
+__all__ = ["RestartStrategy", "NoRestartStrategy", "FixedDelayRestartStrategy",
+           "ExponentialDelayRestartStrategy", "FailureRateRestartStrategy",
+           "restart_strategy_from_config"]
+
+
+class RestartStrategy:
+    def can_restart(self) -> bool:
+        raise NotImplementedError
+
+    def backoff_seconds(self) -> float:
+        raise NotImplementedError
+
+    def notify_failure(self) -> None:
+        pass
+
+    def notify_recovered(self) -> None:
+        """Called after a stretch of healthy running (resets escalation)."""
+
+
+class NoRestartStrategy(RestartStrategy):
+    def can_restart(self) -> bool:
+        return False
+
+    def backoff_seconds(self) -> float:
+        return 0.0
+
+
+class FixedDelayRestartStrategy(RestartStrategy):
+    def __init__(self, attempts: int, delay: float):
+        self.attempts = attempts
+        self.delay = delay
+        self._failures = 0
+
+    def notify_failure(self) -> None:
+        self._failures += 1
+
+    def can_restart(self) -> bool:
+        return self._failures <= self.attempts
+
+    def backoff_seconds(self) -> float:
+        return self.delay
+
+
+class ExponentialDelayRestartStrategy(RestartStrategy):
+    def __init__(self, initial: float, maximum: float, multiplier: float = 2.0,
+                 reset_after: float = 60.0):
+        self.initial = initial
+        self.maximum = maximum
+        self.multiplier = multiplier
+        self.reset_after = reset_after
+        self._current = initial
+        self._last_failure = 0.0
+
+    def notify_failure(self) -> None:
+        now = time.time()
+        if now - self._last_failure > self.reset_after:
+            self._current = self.initial
+        else:
+            self._current = min(self._current * self.multiplier, self.maximum)
+        self._last_failure = now
+
+    def notify_recovered(self) -> None:
+        self._current = self.initial
+
+    def can_restart(self) -> bool:
+        return True
+
+    def backoff_seconds(self) -> float:
+        return self._current
+
+
+class FailureRateRestartStrategy(RestartStrategy):
+    """Give up when more than ``max_failures`` within ``interval`` seconds."""
+
+    def __init__(self, max_failures: int, interval: float, delay: float):
+        self.max_failures = max_failures
+        self.interval = interval
+        self.delay = delay
+        self._failures: list[float] = []
+
+    def notify_failure(self) -> None:
+        now = time.time()
+        self._failures.append(now)
+        self._failures = [t for t in self._failures
+                          if t >= now - self.interval]
+
+    def can_restart(self) -> bool:
+        return len(self._failures) <= self.max_failures
+
+    def backoff_seconds(self) -> float:
+        return self.delay
+
+
+def restart_strategy_from_config(config: Configuration) -> RestartStrategy:
+    kind = config.get(RuntimeOptions.RESTART_STRATEGY)
+    if kind == "none":
+        return NoRestartStrategy()
+    if kind == "fixed-delay":
+        return FixedDelayRestartStrategy(
+            config.get(RuntimeOptions.RESTART_ATTEMPTS),
+            config.get(RuntimeOptions.RESTART_DELAY))
+    if kind == "failure-rate":
+        return FailureRateRestartStrategy(
+            config.get(RuntimeOptions.RESTART_ATTEMPTS),
+            interval=60.0,
+            delay=config.get(RuntimeOptions.RESTART_DELAY))
+    return ExponentialDelayRestartStrategy(
+        config.get(RuntimeOptions.BACKOFF_INITIAL),
+        config.get(RuntimeOptions.BACKOFF_MAX))

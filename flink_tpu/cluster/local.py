@@ -209,6 +209,7 @@ def run_job(job_graph: JobGraph, config: Configuration,
         from ..checkpoint.coordinator import CheckpointCoordinator
         coordinator = CheckpointCoordinator(job, config)
         coordinator.start_periodic()
+    job.coordinator = coordinator
     job.start()
     try:
         job.wait(timeout)

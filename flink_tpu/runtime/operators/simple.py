@@ -54,8 +54,15 @@ class MapOperator(OneInputOperator):
         rows = [self._fn.map(r) for r in batch.iter_rows()]
         if not rows:
             return
+        schema = self._out_schema
+        if schema is None and isinstance(rows[0], tuple) \
+                and len(rows[0]) == len(batch.schema) > 1:
+            # same-arity tuple output: keep the input's column names so
+            # downstream column references (key_by("col")) keep working —
+            # from_rows_infer still promotes dtypes per column as needed
+            schema = batch.schema
         out, self._out_schema = RecordBatch.from_rows_infer(
-            self._out_schema, rows, batch.timestamps)
+            schema, rows, batch.timestamps)
         self.output.emit(out)
 
     def close(self) -> None:

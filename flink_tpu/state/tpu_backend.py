@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.keygroups import KeyGroupRange, key_groups_for_hash_batch
+from ..core.keygroups import KeyGroupRange, hash_batch, \
+    key_groups_for_hash_batch
 from ..ops.hash_table import (
     EMPTY_KEY, lookup, lookup_or_insert, make_table,
 )
@@ -83,11 +84,11 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         dkeys = jnp.asarray(keys)
         while True:
             new_table, slots, ok = lookup_or_insert(self.table, dkeys)
-            if bool(jax.device_get(ok.all())):
+            all_ok, occupancy = jax.device_get(
+                (ok.all(), (new_table != EMPTY_KEY).sum()))
+            if bool(all_ok):
                 self.table = new_table
-                # exact occupancy would need a reduce; cheap upper bound:
-                self._num_keys = int(jax.device_get(
-                    (new_table != EMPTY_KEY).sum()))
+                self._num_keys = int(occupancy)
                 if self._num_keys > 0.6 * self.capacity:
                     self._rehash(self.capacity * 2)
                     # slots computed against the pre-rehash table are stale
@@ -171,6 +172,8 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         handle = self._row_states.get(descriptor.name)
         if handle is None:
             self.register_array_state(descriptor.name, "sum", jnp.float32)
+            self.register_array_state(f"{descriptor.name}.__set__", "sum",
+                                      jnp.int32)
             handle = _TpuValueState(self, descriptor)
             self._row_states[descriptor.name] = handle
         return handle
@@ -190,9 +193,10 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         occupied = t != EMPTY_KEY
         keys = t[occupied]
         slots = np.flatnonzero(occupied)
-        hashes = ((keys.view(np.uint64) ^ (keys.view(np.uint64) >> np.uint64(32)))
-                  & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        groups = key_groups_for_hash_batch(hashes, self.max_parallelism)
+        # same hash as record routing (hash_batch), so restored keys filter
+        # into exactly the key-group ranges the exchange routes them to
+        groups = key_groups_for_hash_batch(hash_batch(keys),
+                                           self.max_parallelism)
         states = {}
         for name, st in self._array_states.items():
             arr = jax.device_get(st.array)
@@ -243,27 +247,49 @@ class TpuKeyedStateBackend(KeyedStateBackend):
 
 
 class _TpuValueState(ValueState):
-    """Row plane: one float32 cell per key (API completeness)."""
+    """Row plane: one float32 cell per key plus a presence bit, so a stored
+    0.0 is distinguishable from 'never written' (API completeness; each call
+    is a host round-trip — the hot path is the array plane)."""
 
     def __init__(self, backend: TpuKeyedStateBackend, desc: StateDescriptor):
         self._b, self._d = backend, desc
 
-    def _slot(self) -> int:
+    def _read_slot(self) -> int:
+        """Lookup WITHOUT insert: reading an absent key must not occupy a
+        table slot (it would leak into snapshots and occupancy)."""
+        key = jnp.asarray(
+            _sanitize_keys(np.asarray([self._b._current_key])))
+        return int(jax.device_get(lookup(self._b.table, key))[0])
+
+    def _write_slot(self) -> int:
         key = np.asarray([self._b._current_key], dtype=np.int64)
         return int(jax.device_get(self._b.slots_for_batch(key))[0])
 
     def value(self):
-        v = float(jax.device_get(
-            self._b.get_array(self._d.name)[self._slot()]))
-        return self._d.default if v == 0.0 and self._d.default is not None else v
+        slot = self._read_slot()
+        if slot < 0:
+            return self._d.default
+        present = int(jax.device_get(
+            self._b.get_array(f"{self._d.name}.__set__")[slot]))
+        if not present:
+            return self._d.default
+        return float(jax.device_get(self._b.get_array(self._d.name)[slot]))
 
     def update(self, value) -> None:
+        slot = self._write_slot()
         arr = self._b.get_array(self._d.name)
-        self._b.set_array(self._d.name,
-                          arr.at[self._slot()].set(float(value)))
+        self._b.set_array(self._d.name, arr.at[slot].set(float(value)))
+        flag = self._b.get_array(f"{self._d.name}.__set__")
+        self._b.set_array(f"{self._d.name}.__set__", flag.at[slot].set(1))
 
     def clear(self) -> None:
-        self.update(0.0)
+        slot = self._read_slot()
+        if slot < 0:
+            return
+        arr = self._b.get_array(self._d.name)
+        self._b.set_array(self._d.name, arr.at[slot].set(0.0))
+        flag = self._b.get_array(f"{self._d.name}.__set__")
+        self._b.set_array(f"{self._d.name}.__set__", flag.at[slot].set(0))
 
 
 register_backend("tpu", TpuKeyedStateBackend)
