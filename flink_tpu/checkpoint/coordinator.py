@@ -133,7 +133,11 @@ class CheckpointCoordinator:
         cp = self.storage.store(cp)
         duration = time.time() - p.started
         with self._lock:
+            # keep the store ordered by checkpoint id, not completion time:
+            # with max-concurrent > 1 a slow older checkpoint may complete
+            # after a newer one, and subsumption must discard the OLDER id
             self._completed.append(cp)
+            self._completed.sort(key=lambda c: c.checkpoint_id)
             self._last_complete_time = time.time()
             self.stats.append({
                 "id": p.checkpoint_id, "savepoint": p.is_savepoint,
@@ -202,7 +206,8 @@ def build_restore_map(checkpoint: CompletedCheckpoint,
     subtasks of its vertex; backends keep only their key-group range.
     Reader/operator state: 1:1 when the vertex parallelism is unchanged;
     otherwise readers restart (splits are re-enumerated) and operator list
-    state is redistributed round-robin.
+    state is redistributed (split: round-robin; union: broadcast) via
+    OperatorStateBackend.redistribute.
     """
     from ..state.backend import OperatorStateBackend
 
@@ -224,6 +229,20 @@ def build_restore_map(checkpoint: CompletedCheckpoint,
         for snap in old.values():
             op_keys.update((snap.get("chain") or {}).keys())
 
+        # rescale path: redistribute each operator's non-keyed list state
+        # across the NEW parallelism (split round-robin / union broadcast)
+        redistributed: dict[str, list[dict]] = {}
+        if not same_par:
+            for op_key in op_keys:
+                op_snaps = [
+                    snap for osub in sorted(old)
+                    if (snap := ((old[osub].get("chain") or {})
+                                 .get(op_key) or {}).get("operator"))
+                    is not None]
+                if op_snaps:
+                    redistributed[op_key] = OperatorStateBackend.redistribute(
+                        op_snaps, vertex.parallelism)
+
         for sub in range(vertex.parallelism):
             task_snap: dict[str, Any] = {}
             if same_par and sub in old:
@@ -238,6 +257,8 @@ def build_restore_map(checkpoint: CompletedCheckpoint,
                         keyed_list.append(op_snap["keyed"])
                     if same_par and osub == sub:
                         operator_state = op_snap.get("operator")
+                if not same_par and op_key in redistributed:
+                    operator_state = redistributed[op_key][sub]
                 chain_map[op_key] = {"keyed_list": keyed_list,
                                      "operator": operator_state}
             if chain_map:

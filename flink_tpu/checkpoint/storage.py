@@ -71,12 +71,14 @@ class FsCheckpointStorage(CheckpointStorage):
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
         d = self._path(checkpoint)
         os.makedirs(d, exist_ok=True)
+        # set the path BEFORE pickling so a checkpoint load()ed from disk
+        # knows where it lives
+        checkpoint.external_path = d
         tmp = os.path.join(d, "_metadata.part")
         with open(tmp, "wb") as f:
             pickle.dump(checkpoint, f, protocol=pickle.HIGHEST_PROTOCOL)
         final = os.path.join(d, "_metadata")
         os.replace(tmp, final)  # atomic publish
-        checkpoint.external_path = d
         return checkpoint
 
     def discard(self, checkpoint: CompletedCheckpoint) -> None:

@@ -117,9 +117,9 @@ def restart_strategy_from_config(config: Configuration) -> RestartStrategy:
             config.get(RuntimeOptions.RESTART_DELAY))
     if kind == "failure-rate":
         return FailureRateRestartStrategy(
-            config.get(RuntimeOptions.RESTART_ATTEMPTS),
-            interval=60.0,
-            delay=config.get(RuntimeOptions.RESTART_DELAY))
+            config.get(RuntimeOptions.FAILURE_RATE_MAX),
+            interval=config.get(RuntimeOptions.FAILURE_RATE_INTERVAL),
+            delay=config.get(RuntimeOptions.FAILURE_RATE_DELAY))
     return ExponentialDelayRestartStrategy(
         config.get(RuntimeOptions.BACKOFF_INITIAL),
         config.get(RuntimeOptions.BACKOFF_MAX))

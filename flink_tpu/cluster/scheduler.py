@@ -43,6 +43,7 @@ class JobSupervisor:
         self.current_job: Optional[LocalJob] = None
         self.coordinator: Optional[CheckpointCoordinator] = None
         self._latest: Optional[CompletedCheckpoint] = None
+        self._rescaling = False  # guards the cancel->redeploy swap window
         self.failures: list[tuple[int, str]] = []  # (attempt, error message)
 
     # -- lifecycle ---------------------------------------------------------
@@ -75,12 +76,17 @@ class JobSupervisor:
                     remaining = (None if deadline is None
                                  else max(deadline - time.time(), 0.1))
                     job.wait(remaining)
-                    if self.current_job is job:
+                    if self.current_job is job and not self._rescaling:
                         break
-                    # rescale() swapped the deployment underneath us: the
-                    # old job's cancel completed normally — keep supervising
-                    # the new one (its coordinator keeps running)
-                    job = self.current_job
+                    if self.current_job is not job:
+                        # rescale() swapped the deployment underneath us:
+                        # the old job's cancel completed normally — keep
+                        # supervising the new one (its coordinator runs on)
+                        job = self.current_job
+                    else:
+                        # rescale() cancelled this job but hasn't installed
+                        # the replacement yet — wait for the swap
+                        time.sleep(0.05)
                 self.coordinator.stop()
                 return job
             except TimeoutError:
@@ -110,10 +116,14 @@ class JobSupervisor:
         the savepoint (AdaptiveScheduler Executing->Restarting->Executing).
         Call from a thread other than the job's tasks."""
         sp = self.coordinator.trigger_savepoint(timeout)
-        self.coordinator.stop()
-        self.current_job.cancel()
-        for vid, par in vertex_parallelism.items():
-            self.job_graph.vertices[vid].parallelism = par
-        self._latest = sp
-        job = self._deploy(sp)
-        job.start()
+        self._rescaling = True
+        try:
+            self.coordinator.stop()
+            self.current_job.cancel()
+            for vid, par in vertex_parallelism.items():
+                self.job_graph.vertices[vid].parallelism = par
+            self._latest = sp
+            job = self._deploy(sp)
+            job.start()
+        finally:
+            self._rescaling = False

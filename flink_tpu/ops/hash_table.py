@@ -100,12 +100,15 @@ def lookup(table_keys: jax.Array, keys: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def lookup_or_insert(table_keys: jax.Array, keys: jax.Array
+def lookup_or_insert(table_keys: jax.Array, keys: jax.Array,
+                     valid: jax.Array | None = None
                      ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Find-or-claim slots for a batch of keys.
 
     Returns (new_table_keys, slots int32, ok bool). Records that exhaust
     MAX_PROBES report ok=False with slot=-1 (host should rehash bigger).
+    Rows where ``valid`` is False never probe or claim (slot=-1, ok=False) —
+    the sharded exchange feeds padded batches through here.
     """
     cap = table_keys.shape[0]
     mask = jnp.uint32(cap - 1)
@@ -133,7 +136,9 @@ def lookup_or_insert(table_keys: jax.Array, keys: jax.Array
         _table, probe, _slot, done = state
         return ((~done) & (probe < MAX_PROBES)).any()
 
+    start_done = (jnp.zeros(n, bool) if valid is None
+                  else ~valid.astype(bool))
     init = (table_keys, jnp.zeros(n, jnp.uint32),
-            jnp.full(n, -1, jnp.int32), jnp.zeros(n, bool))
+            jnp.full(n, -1, jnp.int32), start_done)
     table, _probe, slot, done = jax.lax.while_loop(cond, body, init)
-    return table, slot, done
+    return table, slot, done & (slot >= 0)

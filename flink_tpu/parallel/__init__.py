@@ -1,0 +1,17 @@
+"""Multi-chip execution: mesh topology, keyBy all-to-all exchange, sharded
+keyed-window aggregation (SURVEY.md §2.10 / §5.8 — the ICI-collective
+replacement for the reference's KeyGroupStreamPartitioner + Netty stack)."""
+
+from .exchange import keyby_exchange
+from .mesh import (DATA_AXIS, device_index_for_key_groups, hash_int64_device,
+                   key_groups_device, make_mesh, murmur_mix_device,
+                   shard_ranges)
+from .sharded_window import (AggDef, ShardedWindowAgg, ShardedWindowState,
+                             global_topk)
+
+__all__ = [
+    "DATA_AXIS", "make_mesh", "shard_ranges", "murmur_mix_device",
+    "hash_int64_device", "key_groups_device", "device_index_for_key_groups",
+    "keyby_exchange", "AggDef", "ShardedWindowAgg", "ShardedWindowState",
+    "global_topk",
+]

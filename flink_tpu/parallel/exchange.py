@@ -1,0 +1,65 @@
+"""keyBy exchange over ICI: the all-to-all repartition.
+
+This replaces the reference's hash repartition between subtasks
+(KeyGroupStreamPartitioner + RecordWriter.emit:104 + the Netty
+credit-based channel stack, SURVEY.md §5.8) with ONE XLA collective: every
+device buckets its local micro-batch by destination shard and a single
+`lax.all_to_all` rides the ICI mesh. There are no credits — collectives are
+synchronous, so backpressure collapses to admission control at ingestion
+(SURVEY.md §7 hard-parts).
+
+Shapes are static: each device sends a [n_dev, B] buffer (capacity B per
+destination — worst case the whole local batch hashes to one shard), so no
+record is ever dropped by the exchange itself; invalid (padding) rows are
+routed to a virtual overflow destination and vanish.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["keyby_exchange"]
+
+
+def keyby_exchange(axis_name: str, n_dev: int, dest: jax.Array,
+                   payload: Any, valid: jax.Array) -> tuple[Any, jax.Array]:
+    """Route records to their destination shard. Call INSIDE shard_map.
+
+    dest:    [B] int32 destination mesh position per record
+    payload: pytree of [B, ...] column arrays
+    valid:   [B] bool — padding rows are discarded
+
+    Returns (routed payload pytree of [n_dev * B, ...], routed valid mask
+    [n_dev * B]); routed rows are grouped by source device.
+    """
+    B = dest.shape[0]
+    d = jnp.where(valid, dest, jnp.int32(n_dev))  # invalid -> overflow bucket
+    order = jnp.argsort(d, stable=True)
+    sd = d[order]
+    counts = jnp.sum(jax.nn.one_hot(d, n_dev + 1, dtype=jnp.int32), axis=0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(B, dtype=jnp.int32) - offsets[sd]
+
+    send_valid = jnp.zeros((n_dev, B), bool).at[sd, rank].set(
+        sd < n_dev, mode="drop")
+
+    def scatter(col):
+        buf = jnp.zeros((n_dev, B) + col.shape[1:], col.dtype)
+        return buf.at[sd, rank].set(col[order], mode="drop")
+
+    send = jax.tree.map(scatter, payload)
+    if n_dev == 1:
+        recv, recv_valid = send, send_valid
+    else:
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, axis_name, split_axis=0,
+                                         concat_axis=0), send)
+        recv_valid = jax.lax.all_to_all(send_valid, axis_name, split_axis=0,
+                                        concat_axis=0)
+    routed = jax.tree.map(
+        lambda x: x.reshape((n_dev * B,) + x.shape[2:]), recv)
+    return routed, recv_valid.reshape(n_dev * B)

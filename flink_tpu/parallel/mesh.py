@@ -1,0 +1,91 @@
+"""Mesh helpers + device-side key-group routing.
+
+The multi-chip analog of the reference's key-group assignment
+(KeyGroupRangeAssignment.java: assignToKeyGroup:63,
+computeKeyGroupForKeyHash:75, computeOperatorIndexForKeyGroup:124): the same
+murmur-mix bit-for-bit, lowered to uint32 jnp ops so routing runs on device
+inside shard_map. Parity with the host path (core/keygroups.py) is what makes
+checkpoints produced by host subtasks restorable onto device shards and vice
+versa.
+
+A subtask index here is a position along the mesh's "data" axis; every device
+owns the contiguous key-group range key_group_range_for_operator gives it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.keygroups import KeyGroupRange, key_group_range_for_operator
+from ..ops.hash_table import ensure_x64
+
+__all__ = ["make_mesh", "shard_ranges", "murmur_mix_device",
+           "hash_int64_device", "key_groups_device",
+           "device_index_for_key_groups", "DATA_AXIS"]
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def shard_ranges(max_parallelism: int, n_devices: int) -> list[KeyGroupRange]:
+    """Key-group range owned by each mesh position."""
+    return [key_group_range_for_operator(max_parallelism, n_devices, i)
+            for i in range(n_devices)]
+
+
+def _rotl32(x: jax.Array, r: int) -> jax.Array:
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def murmur_mix_device(code: jax.Array) -> jax.Array:
+    """Device twin of core.keygroups.murmur_mix (uint32 -> non-negative
+    int32), byte-identical to the host path."""
+    k = code.astype(jnp.uint32)
+    k = k * jnp.uint32(0xCC9E2D51)
+    k = _rotl32(k, 15)
+    k = k * jnp.uint32(0x1B873593)
+    h = _rotl32(k, 13)
+    h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    h = h ^ jnp.uint32(4)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    out = h.astype(jnp.int32)
+    return jnp.where(out == jnp.int32(-2147483648), jnp.int32(0),
+                     jnp.abs(out))
+
+
+def hash_int64_device(keys: jax.Array) -> jax.Array:
+    """Device twin of core.keygroups.hash_batch's integer fast path
+    (Long.hashCode fold: v ^ (v >>> 32))."""
+    ensure_x64()
+    u = keys.astype(jnp.int64).view(jnp.uint64)
+    return ((u ^ (u >> jnp.uint64(32)))
+            & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+def key_groups_device(keys: jax.Array, max_parallelism: int) -> jax.Array:
+    """int64 keys -> int32 key groups, matching assign_to_key_group."""
+    return murmur_mix_device(hash_int64_device(keys)) % jnp.int32(
+        max_parallelism)
+
+
+def device_index_for_key_groups(key_groups: jax.Array, n_devices: int,
+                                max_parallelism: int) -> jax.Array:
+    """Device twin of operator_index_for_key_group: kg * p // maxp."""
+    return (key_groups * jnp.int32(n_devices)) // jnp.int32(max_parallelism)

@@ -1,0 +1,235 @@
+"""Sharded slice-window aggregation: the multi-chip north-star path.
+
+One compiled step per micro-batch over the WHOLE mesh (SURVEY.md §2.10
+data-parallelism row + §5.8): every device holds the keyed state for its
+contiguous key-group range (mesh.shard_ranges); a step is
+
+    key-group routing (murmur parity with the host)  ->
+    one `all_to_all` keyBy exchange over ICI          ->
+    device hash-table lookup-or-insert per shard      ->
+    one scatter-fold per aggregate into [ring, cap] pane accumulators
+
+which replaces the reference's per-record WindowOperator.processElement:278 /
+KeyGroupStreamPartitioner / Netty channel pipeline. Window fire is one pane
+merge over all keys of every shard (SliceSharedWindowAggProcessor semantics);
+cross-shard post-aggregations (Nexmark Q5 global hot items) are two-phase:
+per-shard top-k then a tiny gather — the
+StreamExecLocal/GlobalGroupAggregate split.
+
+Everything here is functional: state is a pytree whose leaves carry a leading
+device axis sharded over the mesh's "data" axis, steps are jitted once, and
+the host only touches scalars (watermarks, pane boundaries) — the control
+plane of the DeviceWindowAggOperator, lifted to N chips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.keygroups import key_group_range_for_operator
+from ..ops.hash_table import EMPTY_KEY, ensure_x64, lookup_or_insert, \
+    make_table
+from ..ops.segment_ops import AGG_INITS, make_accumulator, scatter_fold
+from .exchange import keyby_exchange
+from .mesh import DATA_AXIS, device_index_for_key_groups, key_groups_device
+
+__all__ = ["AggDef", "ShardedWindowState", "ShardedWindowAgg",
+           "global_topk"]
+
+
+class AggDef(NamedTuple):
+    """One aggregate accumulator: kind in sum|count|min|max.
+
+    ``count`` needs no input column; others fold the column named ``name``
+    from the step's value dict. (avg = sum + count at fire, like the
+    reference's AggregateFunction.getResult — AggregateFunction.java:114.)
+    """
+    name: str
+    kind: str
+    dtype: Any = jnp.float32
+
+
+class ShardedWindowState(NamedTuple):
+    """Pytree of device arrays; leading axis = mesh position ("data")."""
+    table: jax.Array            # [D, capacity] int64 key table
+    accs: dict                  # name -> [D, ring, capacity]
+    dropped: jax.Array          # [D] int64 records lost to table overflow
+
+
+def _sanitize(keys: jax.Array) -> jax.Array:
+    return jnp.where(keys == jnp.int64(EMPTY_KEY),
+                     jnp.int64(EMPTY_KEY) - 1, keys.astype(jnp.int64))
+
+
+class ShardedWindowAgg:
+    """Factory for the sharded step/fire/retire programs.
+
+    Static config (mesh, aggregates, capacity, ring, max_parallelism) is
+    closed over so each program jits exactly once.
+    """
+
+    def __init__(self, mesh: Mesh, aggs: Sequence[AggDef],
+                 capacity: int = 1 << 16, ring: int = 64,
+                 max_parallelism: int = 128):
+        ensure_x64()
+        if capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two")
+        self.mesh = mesh
+        self.n_dev = mesh.devices.size
+        if max_parallelism < self.n_dev:
+            raise ValueError("max_parallelism must be >= mesh size")
+        self.aggs = list(aggs)
+        if not any(a.kind == "count" for a in self.aggs):
+            self.aggs.append(AggDef("__count__", "count", jnp.int64))
+        self.capacity = capacity
+        self.ring = ring
+        self.max_parallelism = max_parallelism
+        self.shard_ranges = [
+            key_group_range_for_operator(max_parallelism, self.n_dev, i)
+            for i in range(self.n_dev)]
+        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._step = self._build_step()
+        self._fire = self._build_fire()
+        self._retire = self._build_retire()
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> ShardedWindowState:
+        D, cap, ring = self.n_dev, self.capacity, self.ring
+        with self.mesh:
+            table = jax.device_put(
+                jnp.tile(make_table(cap)[None], (D, 1)), self._sharding)
+            accs = {
+                a.name: jax.device_put(
+                    jnp.tile(make_accumulator(a.kind, (ring, cap),
+                                              a.dtype)[None], (D, 1, 1)),
+                    self._sharding)
+                for a in self.aggs}
+            dropped = jax.device_put(jnp.zeros(D, jnp.int64), self._sharding)
+        return ShardedWindowState(table, accs, dropped)
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        D, cap, ring = self.n_dev, self.capacity, self.ring
+        MP = self.max_parallelism
+        aggs = self.aggs
+
+        def shard_body(table, accs, dropped, keys, cols, panes, valid):
+            table, keys = table[0], keys[0]
+            accs = {k: v[0] for k, v in accs.items()}
+            cols = {k: v[0] for k, v in cols.items()}
+            panes, valid = panes[0], valid[0]
+
+            kg = key_groups_device(keys, MP)
+            dest = device_index_for_key_groups(kg, D, MP)
+            payload = {"__key__": _sanitize(keys), "__pane__": panes, **cols}
+            routed, rvalid = keyby_exchange(DATA_AXIS, D, dest, payload,
+                                            valid)
+            table, slots, ok = lookup_or_insert(table, routed["__key__"],
+                                                rvalid)
+            n_dropped = jnp.sum(rvalid & ~ok).astype(jnp.int64)
+            ring_idx = jnp.where(ok, (routed["__pane__"] % ring), 0).astype(
+                jnp.int32)
+            flat = ring_idx * cap + jnp.maximum(slots, 0)
+            for a in aggs:
+                vals = (jnp.ones(flat.shape[0], a.dtype)
+                        if a.kind == "count" else routed[a.name])
+                accs[a.name] = scatter_fold(
+                    a.kind, accs[a.name].reshape(-1), flat, vals,
+                    ok).reshape(ring, cap)
+            processed = jax.lax.psum(jnp.sum(ok).astype(jnp.int64),
+                                     DATA_AXIS)
+            return (table[None], {k: v[None] for k, v in accs.items()},
+                    dropped + n_dropped, processed)
+
+        spec = P(DATA_AXIS)
+        state_specs = (spec, {a.name: spec for a in aggs}, spec)
+        mapped = jax.shard_map(
+            shard_body, mesh=self.mesh,
+            in_specs=state_specs + (spec,
+                                    {a.name: spec for a in aggs
+                                     if a.kind != "count"},
+                                    spec, spec),
+            out_specs=state_specs + (P(),),
+            check_vma=False)
+
+        @jax.jit
+        def step(state: ShardedWindowState, keys, cols, panes, valid):
+            table, accs, dropped, processed = mapped(
+                state.table, state.accs, state.dropped, keys, cols, panes,
+                valid)
+            return ShardedWindowState(table, accs, dropped), processed
+
+        return step
+
+    def step(self, state: ShardedWindowState, keys: jax.Array, cols: dict,
+             panes: jax.Array, valid: jax.Array
+             ) -> tuple[ShardedWindowState, jax.Array]:
+        """Fold one micro-batch. keys/panes/valid: [D, B]; cols: dict of
+        [D, B] value columns (one per non-count aggregate)."""
+        return self._step(state, keys, cols, panes, valid)
+
+    # ------------------------------------------------------------------
+    def _build_fire(self):
+        aggs = self.aggs
+        count_name = next(a.name for a in aggs if a.kind == "count")
+        merges = {"sum": jnp.sum, "count": jnp.sum, "min": jnp.min,
+                  "max": jnp.max}
+
+        @jax.jit
+        def fire(state: ShardedWindowState, pane_rows: jax.Array):
+            out = {a.name: merges[a.kind](
+                state.accs[a.name][:, pane_rows, :], axis=1) for a in aggs}
+            count = out[count_name]
+            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+            return out, emit
+
+        return fire
+
+    def fire(self, state: ShardedWindowState, pane_rows: np.ndarray
+             ) -> tuple[dict, jax.Array]:
+        """Merge the given ring rows into per-key window results
+        ([D, capacity] per aggregate) + emit mask. Keys = state.table."""
+        return self._fire(state, jnp.asarray(pane_rows, jnp.int32))
+
+    # ------------------------------------------------------------------
+    def _build_retire(self):
+        aggs = self.aggs
+
+        @jax.jit
+        def retire(state: ShardedWindowState, row: jax.Array):
+            accs = {
+                a.name: state.accs[a.name].at[:, row].set(
+                    AGG_INITS[a.kind](state.accs[a.name].dtype))
+                for a in aggs}
+            return state._replace(accs=accs)
+
+        return retire
+
+    def retire_row(self, state: ShardedWindowState,
+                   row: int) -> ShardedWindowState:
+        """Reset one ring row across all shards (pane retirement)."""
+        return self._retire(state, jnp.int32(row))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def global_topk(values: jax.Array, valid: jax.Array, k: int
+                ) -> tuple[jax.Array, jax.Array]:
+    """Two-phase global top-k over sharded [D, capacity] per-key values
+    (Nexmark Q5 hot items): per-shard top-k, then merge the D*k candidates.
+    Returns (values [k], flat indices [k] into the [D*capacity] layout)."""
+    neg = (jnp.finfo(values.dtype).min
+           if jnp.issubdtype(values.dtype, jnp.floating)
+           else jnp.iinfo(values.dtype).min)
+    masked = jnp.where(valid, values, neg)
+    D, cap = masked.shape
+    kk = min(k, cap)
+    local_v, local_i = jax.lax.top_k(masked, kk)          # [D, kk]
+    flat_i = local_i + (jnp.arange(D, dtype=jnp.int32)[:, None] * cap)
+    merged_v, sel = jax.lax.top_k(local_v.reshape(-1), min(k, D * kk))
+    return merged_v, flat_i.reshape(-1)[sel]

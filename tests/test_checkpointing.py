@@ -69,7 +69,6 @@ class TestCheckpointing:
         total = 30000
         s = env.datagen(_gen, SCHEMA, count=total, rate_per_sec=60000,
                         timestamp_column="ts", watermark_strategy=WS)
-        from flink_tpu.api.datastream import DataStream
         (s.map(FailOnce(trip_at=total // 2), name="FailOnce")
          .key_by("key")
          .window(TumblingEventTimeWindows.of(1000))

@@ -1,0 +1,158 @@
+"""Multi-chip sharded execution on the 8-device virtual CPU mesh — the
+MiniCluster-analog tier (SURVEY.md §4 tier 3): real collectives, real
+sharding, one process."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import (assign_to_key_group, hash_batch,
+                                      key_groups_for_hash_batch,
+                                      operator_index_for_key_group)
+from flink_tpu.parallel import (AggDef, ShardedWindowAgg, global_topk,
+                                key_groups_device, make_mesh, shard_ranges)
+from flink_tpu.parallel.mesh import device_index_for_key_groups
+
+from flink_tpu.ops.hash_table import ensure_x64
+
+ensure_x64()  # int64 keys on device (flipped before any test array exists)
+
+MP = 128
+
+
+def test_device_key_groups_match_host():
+    keys = np.concatenate([
+        np.arange(-50, 50, dtype=np.int64),
+        np.random.RandomState(0).randint(-2**62, 2**62, 500, dtype=np.int64),
+    ])
+    host = key_groups_for_hash_batch(hash_batch(keys), MP)
+    dev = np.asarray(jax.device_get(key_groups_device(jnp.asarray(keys), MP)))
+    np.testing.assert_array_equal(host, dev)
+    # spot-check the scalar path too
+    for k in [0, 1, -1, 2**40, -(2**40)]:
+        assert assign_to_key_group(int(k), MP) == int(
+            jax.device_get(key_groups_device(jnp.asarray([k]), MP))[0])
+
+
+def test_device_index_matches_host():
+    kg = jnp.arange(MP, dtype=jnp.int32)
+    dev = np.asarray(jax.device_get(device_index_for_key_groups(kg, 8, MP)))
+    host = np.array([operator_index_for_key_group(MP, 8, g)
+                     for g in range(MP)])
+    np.testing.assert_array_equal(host, dev)
+
+
+def _host_window_sums(keys, vals, panes):
+    out = {}
+    for k, v, p in zip(keys, vals, panes):
+        out.setdefault((int(k), int(p)), [0, 0.0])
+        out[(int(k), int(p))][0] += 1
+        out[(int(k), int(p))][1] += float(v)
+    return out
+
+
+@pytest.fixture
+def agg8():
+    mesh = make_mesh(8)
+    return mesh, ShardedWindowAgg(
+        mesh, [AggDef("price", "sum", jnp.float64)],
+        capacity=1 << 12, ring=8, max_parallelism=MP)
+
+
+def test_sharded_step_matches_host(agg8):
+    mesh, agg = agg8
+    rng = np.random.RandomState(42)
+    D, B = 8, 64
+    state = agg.init_state()
+    all_k, all_v, all_p = [], [], []
+    for _ in range(5):
+        keys = rng.randint(0, 1000, (D, B)).astype(np.int64)
+        vals = rng.rand(D, B)
+        panes = rng.randint(0, 4, (D, B)).astype(np.int64)
+        valid = rng.rand(D, B) < 0.9
+        all_k.append(keys[valid]); all_v.append(vals[valid])
+        all_p.append(panes[valid])
+        state, processed = agg.step(
+            state, jnp.asarray(keys), {"price": jnp.asarray(vals)},
+            jnp.asarray(panes), jnp.asarray(valid))
+        assert int(processed) == int(valid.sum())
+    assert int(jax.device_get(state.dropped).sum()) == 0
+
+    keys = np.concatenate(all_k); vals = np.concatenate(all_v)
+    panes = np.concatenate(all_p)
+    expected = _host_window_sums(keys, vals, panes)
+
+    # every key must live on the shard owning its key group
+    table = np.asarray(jax.device_get(state.table))
+    ranges = shard_ranges(MP, 8)
+    for d in range(8):
+        present = table[d][table[d] != np.iinfo(np.int64).max]
+        for k in present:
+            assert assign_to_key_group(int(k), MP) in ranges[d]
+
+    # single-pane fire: pane p alone -> per (key, pane) sums
+    for p in range(4):
+        out, emit = agg.fire(state, np.array([p % agg.ring], np.int32))
+        emit_np = np.asarray(jax.device_get(emit))
+        counts = np.asarray(jax.device_get(out["__count__"]))
+        sums = np.asarray(jax.device_get(out["price"]))
+        got = {}
+        for d in range(8):
+            for s in np.flatnonzero(emit_np[d]):
+                got[int(table[d, s])] = (int(counts[d, s]),
+                                         float(sums[d, s]))
+        want = {k: tuple(v) for (k, pp), v in expected.items() if pp == p}
+        assert set(got) == set(want)
+        for k in want:
+            assert got[k][0] == want[k][0]
+            np.testing.assert_allclose(got[k][1], want[k][1], rtol=1e-9)
+
+
+def test_fire_merges_panes_and_retire(agg8):
+    mesh, agg = agg8
+    state = agg.init_state()
+    D, B = 8, 16
+    keys = np.tile(np.arange(B, dtype=np.int64), (D, 1))
+    vals = np.ones((D, B))
+    for pane in (0, 1, 2):
+        panes = np.full((D, B), pane, np.int64)
+        state, _ = agg.step(state, jnp.asarray(keys),
+                            {"price": jnp.asarray(vals)},
+                            jnp.asarray(panes),
+                            jnp.ones((D, B), bool))
+    # window = panes {0,1}: each key appears D times per pane
+    out, emit = agg.fire(state, np.array([0, 1], np.int32))
+    counts = np.asarray(jax.device_get(out["__count__"]))
+    assert counts[np.asarray(jax.device_get(emit))].sum() == 2 * D * B
+    # retire pane 0 -> only pane 1 remains in a {0,1} fire
+    state = agg.retire_row(state, 0)
+    out, emit = agg.fire(state, np.array([0, 1], np.int32))
+    counts = np.asarray(jax.device_get(out["__count__"]))
+    assert counts[np.asarray(jax.device_get(emit))].sum() == D * B
+
+
+def test_overflow_reports_dropped():
+    mesh = make_mesh(8)
+    agg = ShardedWindowAgg(mesh, [AggDef("v", "sum", jnp.float64)],
+                           capacity=8, ring=2, max_parallelism=MP)
+    state = agg.init_state()
+    D, B = 8, 64
+    rng = np.random.RandomState(1)
+    keys = rng.randint(0, 10**9, (D, B)).astype(np.int64)
+    state, processed = agg.step(
+        state, jnp.asarray(keys), {"v": jnp.ones((D, B))},
+        jnp.zeros((D, B), np.int64), jnp.ones((D, B), bool))
+    dropped = int(jax.device_get(state.dropped).sum())
+    assert dropped > 0
+    assert int(processed) + dropped == D * B
+
+
+def test_global_topk():
+    vals = jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8))
+    valid = jnp.ones((8, 8), bool).at[7, 7].set(False)  # mask the max
+    v, idx = global_topk(vals, valid, 3)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(v)),
+                                  [62.0, 61.0, 60.0])
+    np.testing.assert_array_equal(np.asarray(jax.device_get(idx)),
+                                  [62, 61, 60])
