@@ -1,0 +1,119 @@
+"""Headline benchmark: Nexmark Q5-shaped hot-items aggregation.
+
+Measures steady-state events/sec of the device micro-batch fold (the
+north-star hot path: hash-table lookup-or-insert + scatter-fold pane
+accumulation over 1M active keys, BASELINE.md config #3) on whatever chip
+jax.devices()[0] is, and compares against an in-process per-record host
+loop over a Python dict — the analog of the reference's heap-backend
+WindowOperator.processElement hot loop (WindowOperator.java:278), which is
+itself faster per-core than the RocksDB backend the target is defined
+against.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+N_KEYS = 1_000_000
+CAPACITY = 1 << 21          # 2x keys, power of two
+RING = 8
+BATCH = 1 << 17
+N_BATCHES = 8               # distinct pre-generated batches, cycled
+WARMUP = 3
+TIMED = 24
+HOST_EVENTS = 400_000
+
+
+def bench_device() -> float:
+    import jax
+    import jax.numpy as jnp
+    from flink_tpu.ops.hash_table import ensure_x64, lookup_or_insert, \
+        make_table
+    from flink_tpu.ops.segment_ops import make_accumulator, scatter_fold
+
+    ensure_x64()
+
+    @jax.jit
+    def step(table, count_acc, sum_acc, keys, values, panes):
+        table, slots, ok = lookup_or_insert(table, keys)
+        ring_idx = jnp.where(ok, panes % RING, 0).astype(jnp.int32)
+        flat = ring_idx * CAPACITY + jnp.maximum(slots, 0)
+        count_acc = scatter_fold(
+            "count", count_acc.reshape(-1), flat,
+            jnp.ones(keys.shape[0], jnp.int64), ok).reshape(RING, CAPACITY)
+        sum_acc = scatter_fold(
+            "sum", sum_acc.reshape(-1), flat, values,
+            ok).reshape(RING, CAPACITY)
+        return table, count_acc, sum_acc
+
+    rng = np.random.default_rng(42)
+    # zipf-ish hot-key skew like Nexmark auction bids
+    raw = rng.zipf(1.1, size=(N_BATCHES, BATCH)).astype(np.int64)
+    keys_h = raw % N_KEYS
+    vals_h = rng.random((N_BATCHES, BATCH), np.float32)
+    panes_h = rng.integers(0, RING, (N_BATCHES, BATCH), np.int64)
+    dev = jax.devices()[0]
+    keys = [jax.device_put(jnp.asarray(k), dev) for k in keys_h]
+    vals = [jax.device_put(jnp.asarray(v), dev) for v in vals_h]
+    panes = [jax.device_put(jnp.asarray(p), dev) for p in panes_h]
+
+    table = jax.device_put(make_table(CAPACITY), dev)
+    count_acc = jax.device_put(
+        make_accumulator("count", (RING, CAPACITY), jnp.int64), dev)
+    sum_acc = jax.device_put(
+        make_accumulator("sum", (RING, CAPACITY), jnp.float32), dev)
+
+    for i in range(WARMUP):
+        j = i % N_BATCHES
+        table, count_acc, sum_acc = step(table, count_acc, sum_acc,
+                                         keys[j], vals[j], panes[j])
+    jax.block_until_ready(table)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED):
+        j = i % N_BATCHES
+        table, count_acc, sum_acc = step(table, count_acc, sum_acc,
+                                         keys[j], vals[j], panes[j])
+    jax.block_until_ready((table, count_acc, sum_acc))
+    dt = time.perf_counter() - t0
+    return TIMED * BATCH / dt
+
+
+def bench_host() -> float:
+    rng = np.random.default_rng(42)
+    keys = (rng.zipf(1.1, size=HOST_EVENTS).astype(np.int64)
+            % N_KEYS).tolist()
+    vals = rng.random(HOST_EVENTS).tolist()
+    panes = rng.integers(0, RING, HOST_EVENTS).tolist()
+    state: dict = {}
+    t0 = time.perf_counter()
+    for k, v, p in zip(keys, vals, panes):
+        acc = state.get((k, p))
+        if acc is None:
+            state[(k, p)] = [1, v]
+        else:
+            acc[0] += 1
+            acc[1] += v
+    dt = time.perf_counter() - t0
+    return HOST_EVENTS / dt
+
+
+def main() -> None:
+    device_eps = bench_device()
+    host_eps = bench_host()
+    print(json.dumps({
+        "metric": "nexmark_q5_hot_items_events_per_sec_1M_keys",
+        "value": round(device_eps, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(device_eps / host_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,9 @@ class JobSupervisor:
             job.start()
             try:
                 while True:
+                    if deadline is not None and time.time() >= deadline:
+                        raise TimeoutError(
+                            f"job did not finish within {timeout}s")
                     remaining = (None if deadline is None
                                  else max(deadline - time.time(), 0.1))
                     job.wait(remaining)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["scatter_fold", "pane_window_merge", "AGG_INITS", "AGG_FOLDS",
-           "make_accumulator", "segment_topk"]
+           "AGG_MERGES", "make_accumulator", "segment_topk"]
 
 
 def _scatter_add(acc, idx, vals):
@@ -55,12 +55,14 @@ AGG_FOLDS = {
     "max": _scatter_max,
 }
 
-_MERGES = {
+#: kind -> pane-merge reduction (callable(x, axis=...))
+AGG_MERGES = {
     "sum": jnp.sum,
     "count": jnp.sum,
     "min": lambda x, axis: jnp.min(x, axis=axis),
     "max": lambda x, axis: jnp.max(x, axis=axis),
 }
+_MERGES = AGG_MERGES
 
 
 def make_accumulator(kind: str, shape: tuple[int, ...], dtype) -> jax.Array:

@@ -25,19 +25,20 @@ plane of the DeviceWindowAggOperator, lifted to N chips.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple, Optional, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core.keygroups import key_group_range_for_operator
 from ..ops.hash_table import EMPTY_KEY, ensure_x64, lookup_or_insert, \
     make_table
-from ..ops.segment_ops import AGG_INITS, make_accumulator, scatter_fold
+from ..ops.segment_ops import AGG_INITS, AGG_MERGES, make_accumulator, \
+    scatter_fold
 from .exchange import keyby_exchange
-from .mesh import DATA_AXIS, device_index_for_key_groups, key_groups_device
+from .mesh import DATA_AXIS, device_index_for_key_groups, \
+    key_groups_device, shard_ranges
 
 __all__ = ["AggDef", "ShardedWindowState", "ShardedWindowAgg",
            "global_topk"]
@@ -87,12 +88,13 @@ class ShardedWindowAgg:
         self.aggs = list(aggs)
         if not any(a.kind == "count" for a in self.aggs):
             self.aggs.append(AggDef("__count__", "count", jnp.int64))
+        names = [a.name for a in self.aggs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate aggregate names: {names}")
         self.capacity = capacity
         self.ring = ring
         self.max_parallelism = max_parallelism
-        self.shard_ranges = [
-            key_group_range_for_operator(max_parallelism, self.n_dev, i)
-            for i in range(self.n_dev)]
+        self.shard_ranges = shard_ranges(max_parallelism, self.n_dev)
         self._sharding = NamedSharding(mesh, P(DATA_AXIS))
         self._step = self._build_step()
         self._fire = self._build_fire()
@@ -178,12 +180,10 @@ class ShardedWindowAgg:
     def _build_fire(self):
         aggs = self.aggs
         count_name = next(a.name for a in aggs if a.kind == "count")
-        merges = {"sum": jnp.sum, "count": jnp.sum, "min": jnp.min,
-                  "max": jnp.max}
 
         @jax.jit
         def fire(state: ShardedWindowState, pane_rows: jax.Array):
-            out = {a.name: merges[a.kind](
+            out = {a.name: AGG_MERGES[a.kind](
                 state.accs[a.name][:, pane_rows, :], axis=1) for a in aggs}
             count = out[count_name]
             emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
@@ -219,10 +219,14 @@ class ShardedWindowAgg:
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def global_topk(values: jax.Array, valid: jax.Array, k: int
-                ) -> tuple[jax.Array, jax.Array]:
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-phase global top-k over sharded [D, capacity] per-key values
     (Nexmark Q5 hot items): per-shard top-k, then merge the D*k candidates.
-    Returns (values [k], flat indices [k] into the [D*capacity] layout)."""
+    Returns (values [k], flat indices [k] into the [D*capacity] layout,
+    ok [k] bool). Entries with ok=False are padding (fewer than k valid
+    slots existed); their values/indices must be ignored — for integer
+    dtypes the sentinel is indistinguishable from a real minimum, so
+    always filter on ``ok``, not on the values."""
     neg = (jnp.finfo(values.dtype).min
            if jnp.issubdtype(values.dtype, jnp.floating)
            else jnp.iinfo(values.dtype).min)
@@ -230,6 +234,7 @@ def global_topk(values: jax.Array, valid: jax.Array, k: int
     D, cap = masked.shape
     kk = min(k, cap)
     local_v, local_i = jax.lax.top_k(masked, kk)          # [D, kk]
+    local_ok = jnp.take_along_axis(valid, local_i, axis=1)
     flat_i = local_i + (jnp.arange(D, dtype=jnp.int32)[:, None] * cap)
     merged_v, sel = jax.lax.top_k(local_v.reshape(-1), min(k, D * kk))
-    return merged_v, flat_i.reshape(-1)[sel]
+    return merged_v, flat_i.reshape(-1)[sel], local_ok.reshape(-1)[sel]

@@ -151,8 +151,19 @@ def test_overflow_reports_dropped():
 def test_global_topk():
     vals = jnp.asarray(np.arange(64, dtype=np.float32).reshape(8, 8))
     valid = jnp.ones((8, 8), bool).at[7, 7].set(False)  # mask the max
-    v, idx = global_topk(vals, valid, 3)
+    v, idx, ok = global_topk(vals, valid, 3)
     np.testing.assert_array_equal(np.asarray(jax.device_get(v)),
                                   [62.0, 61.0, 60.0])
     np.testing.assert_array_equal(np.asarray(jax.device_get(idx)),
                                   [62, 61, 60])
+    assert np.asarray(jax.device_get(ok)).all()
+
+
+def test_global_topk_fewer_valid_than_k():
+    vals = jnp.asarray(np.arange(16, dtype=np.int64).reshape(4, 4))
+    valid = jnp.zeros((4, 4), bool).at[1, 2].set(True).at[2, 3].set(True)
+    v, idx, ok = global_topk(vals, valid, 5)
+    ok_h = np.asarray(jax.device_get(ok))
+    assert ok_h.sum() == 2
+    kept = np.asarray(jax.device_get(idx))[ok_h]
+    np.testing.assert_array_equal(sorted(kept), [6, 11])
