@@ -1,0 +1,32 @@
+"""SQL/Table layer: parser, planner, changelog operators, TableEnvironment.
+
+The TPU-native counterpart of the reference's flink-table stack (SURVEY.md
+§2.6): TableEnvironmentImpl.executeSql -> Calcite plan -> Janino codegen
+becomes parse() -> plan() -> vectorized column closures over RecordBatches,
+with keyed aggregations lowered to the device slice-window /
+scatter-fold path where eligible.
+"""
+
+from .expressions import (
+    AggCall, BinaryOp, Cast, CaseWhen, Column, Expr, ExprError, FuncCall,
+    Literal, Star, UnaryOp, compile_expr,
+)
+from .group_agg import GroupAggOperator, SqlAggSpec
+from .parser import SelectStmt, SqlError, TableRef, WindowTVF, parse
+from .planner import PlanError, plan
+from .rowkind import (
+    DELETE, INSERT, ROWKIND_COLUMN, ROWKIND_NAMES, UPDATE_AFTER,
+    UPDATE_BEFORE,
+)
+from .table_env import Table, TableEnvironment, TableResult
+from .topn import TopNOperator
+
+__all__ = [
+    "TableEnvironment", "Table", "TableResult", "parse", "plan",
+    "SelectStmt", "SqlError", "PlanError", "TableRef", "WindowTVF",
+    "GroupAggOperator", "SqlAggSpec", "TopNOperator",
+    "Expr", "Column", "Literal", "BinaryOp", "UnaryOp", "FuncCall", "Cast",
+    "CaseWhen", "Star", "AggCall", "ExprError", "compile_expr",
+    "ROWKIND_COLUMN", "ROWKIND_NAMES", "INSERT", "UPDATE_BEFORE",
+    "UPDATE_AFTER", "DELETE",
+]

@@ -1,0 +1,445 @@
+"""SQL parser: tokenizer + recursive descent over the streaming subset.
+
+The analog of the reference's Calcite/JavaCC dialect (flink-sql-parser) for
+the surface the planner supports:
+
+    SELECT items FROM table_ref [WHERE e] [GROUP BY e, ...] [HAVING e]
+        [ORDER BY e [ASC|DESC], ...] [LIMIT n]
+
+``table_ref`` is a table name, a windowing TVF over one —
+``TUMBLE(TABLE t, DESCRIPTOR(ts_col), INTERVAL '5' SECOND)`` /
+``HOP(TABLE t, DESCRIPTOR(ts_col), INTERVAL slide, INTERVAL size)``
+(FLIP-145 window TVFs; reference SqlWindowTableFunction) — or a
+parenthesized subquery. Aggregates: COUNT(*)/COUNT/SUM/MIN/MAX/AVG
+[DISTINCT]. No external parser dependency: the grammar is small enough that
+a hand-rolled LL(1) parser is clearer than bundling a generator.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Union
+
+from .expressions import (
+    AggCall, BinaryOp, Cast, CaseWhen, Column, Expr, FuncCall, Literal, Star,
+    UnaryOp,
+)
+
+__all__ = ["parse", "SelectStmt", "TableRef", "WindowTVF", "OrderItem",
+           "SelectItem", "SqlError"]
+
+_AGG_FUNCS = {"COUNT", "SUM", "MIN", "MAX", "AVG"}
+
+_UNITS_MS = {
+    "MILLISECOND": 1, "SECOND": 1000, "MINUTE": 60_000, "HOUR": 3_600_000,
+    "DAY": 86_400_000,
+}
+
+
+class SqlError(ValueError):
+    pass
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableRef:
+    name: str
+
+
+@dataclass
+class WindowTVF:
+    kind: str                   # "TUMBLE" | "HOP" | "CUMULATE"
+    table: "FromClause"
+    time_col: str
+    size_ms: int
+    slide_ms: Optional[int] = None   # HOP slide / CUMULATE step
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    descending: bool = False
+
+
+@dataclass
+class SelectStmt:
+    items: list
+    from_: "FromClause"
+    where: Optional[Expr] = None
+    group_by: list = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+FromClause = Union[TableRef, WindowTVF, SelectStmt]
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d+|\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.)
+    )""", re.VERBOSE)
+
+
+def _tokenize(sql: str) -> list[tuple[str, str]]:
+    tokens: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            if sql[pos:].strip() == "" or sql[pos] == ";":
+                break
+            raise SqlError(f"cannot tokenize at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            tokens.append(("num", m.group("num")))
+        elif m.lastgroup == "str":
+            tokens.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "id":
+            tokens.append(("id", m.group("id")))
+        else:
+            tokens.append(("op", m.group("op")))
+    tokens.append(("eof", ""))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.toks = _tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self) -> tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        k, v = self.peek()
+        return k == "id" and v.upper() in kws
+
+    def eat_kw(self, kw: str) -> bool:
+        if self.at_kw(kw):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.eat_kw(kw):
+            raise SqlError(f"expected {kw}, got {self.peek()[1]!r}")
+
+    def eat_op(self, op: str) -> bool:
+        k, v = self.peek()
+        if k == "op" and v == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            raise SqlError(f"expected {op!r}, got {self.peek()[1]!r}")
+
+    # -- grammar -----------------------------------------------------------
+    def parse_select(self) -> SelectStmt:
+        self.expect_kw("SELECT")
+        items = [self.select_item()]
+        while self.eat_op(","):
+            items.append(self.select_item())
+        self.expect_kw("FROM")
+        from_ = self.from_clause()
+        stmt = SelectStmt(items, from_)
+        if self.eat_kw("WHERE"):
+            stmt.where = self.expr()
+        if self.eat_kw("GROUP"):
+            self.expect_kw("BY")
+            stmt.group_by = [self.expr()]
+            while self.eat_op(","):
+                stmt.group_by.append(self.expr())
+        if self.eat_kw("HAVING"):
+            stmt.having = self.expr()
+        if self.eat_kw("ORDER"):
+            self.expect_kw("BY")
+            stmt.order_by = [self.order_item()]
+            while self.eat_op(","):
+                stmt.order_by.append(self.order_item())
+        if self.eat_kw("LIMIT"):
+            k, v = self.next()
+            if k != "num":
+                raise SqlError("LIMIT expects a number")
+            stmt.limit = int(v)
+        return stmt
+
+    def select_item(self) -> SelectItem:
+        if self.eat_op("*"):
+            return SelectItem(Star())
+        e = self.expr()
+        alias = None
+        if self.eat_kw("AS"):
+            k, v = self.next()
+            if k != "id":
+                raise SqlError("expected alias after AS")
+            alias = v
+        elif self.peek()[0] == "id" and not self.at_kw(
+                "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT"):
+            alias = self.next()[1]
+        return SelectItem(e, alias)
+
+    def order_item(self) -> OrderItem:
+        e = self.expr()
+        desc = False
+        if self.eat_kw("DESC"):
+            desc = True
+        else:
+            self.eat_kw("ASC")
+        return OrderItem(e, desc)
+
+    def from_clause(self) -> FromClause:
+        if self.eat_op("("):
+            inner = self.from_clause_inner()
+            self.expect_op(")")
+            self.maybe_alias()
+            return inner
+        k, v = self.peek()
+        if k == "id" and v.upper() in ("TUMBLE", "HOP", "CUMULATE"):
+            return self.window_tvf()
+        if k != "id":
+            raise SqlError(f"expected table name, got {v!r}")
+        self.next()
+        self.maybe_alias()
+        return TableRef(v)
+
+    def from_clause_inner(self) -> FromClause:
+        if self.at_kw("SELECT"):
+            return self.parse_select()
+        if self.at_kw("TUMBLE", "HOP", "CUMULATE"):
+            return self.window_tvf()
+        if self.at_kw("TABLE"):
+            self.next()
+            k, v = self.next()
+            if k != "id":
+                raise SqlError("expected table name after TABLE")
+            return TableRef(v)
+        k, v = self.next()
+        if k != "id":
+            raise SqlError(f"expected table reference, got {v!r}")
+        return TableRef(v)
+
+    def maybe_alias(self) -> None:
+        if self.eat_kw("AS"):
+            self.next()
+        elif (self.peek()[0] == "id"
+              and not self.at_kw("WHERE", "GROUP", "HAVING", "ORDER",
+                                 "LIMIT", "ON", "JOIN")):
+            self.next()
+
+    def window_tvf(self) -> WindowTVF:
+        kind = self.next()[1].upper()
+        self.expect_op("(")
+        self.expect_kw("TABLE")
+        k, tname = self.next()
+        if k != "id":
+            raise SqlError("expected table name after TABLE")
+        self.expect_op(",")
+        self.expect_kw("DESCRIPTOR")
+        self.expect_op("(")
+        k, time_col = self.next()
+        if k != "id":
+            raise SqlError("expected column in DESCRIPTOR")
+        self.expect_op(")")
+        self.expect_op(",")
+        first = self.interval()
+        slide = None
+        size = first
+        if self.eat_op(","):
+            second = self.interval()
+            slide, size = first, second
+        self.expect_op(")")
+        self.maybe_alias()
+        if kind == "TUMBLE":
+            return WindowTVF(kind, TableRef(tname), time_col, size)
+        return WindowTVF(kind, TableRef(tname), time_col, size, slide)
+
+    def interval(self) -> int:
+        self.expect_kw("INTERVAL")
+        k, v = self.next()
+        if k == "str":
+            amount = float(v)
+        elif k == "num":
+            amount = float(v)
+        else:
+            raise SqlError("INTERVAL expects a quoted number")
+        k, unit = self.next()
+        if k != "id" or unit.upper().rstrip("S") not in _UNITS_MS:
+            raise SqlError(f"unknown interval unit {unit!r}")
+        return int(amount * _UNITS_MS[unit.upper().rstrip("S")])
+
+    # -- expressions (precedence: OR < AND < NOT < cmp < add < mul < unary)
+    def expr(self) -> Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> Expr:
+        e = self.and_expr()
+        while self.at_kw("OR"):
+            self.next()
+            e = BinaryOp("OR", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> Expr:
+        e = self.not_expr()
+        while self.at_kw("AND"):
+            self.next()
+            e = BinaryOp("AND", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> Expr:
+        if self.at_kw("NOT"):
+            self.next()
+            return UnaryOp("NOT", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self) -> Expr:
+        e = self.add_expr()
+        if self.at_kw("BETWEEN"):
+            self.next()
+            lo = self.add_expr()
+            self.expect_kw("AND")
+            hi = self.add_expr()
+            return BinaryOp("AND", BinaryOp(">=", e, lo),
+                            BinaryOp("<=", e, hi))
+        if self.at_kw("IN"):
+            self.next()
+            self.expect_op("(")
+            opts = [self.expr()]
+            while self.eat_op(","):
+                opts.append(self.expr())
+            self.expect_op(")")
+            out: Expr = BinaryOp("=", e, opts[0])
+            for o in opts[1:]:
+                out = BinaryOp("OR", out, BinaryOp("=", e, o))
+            return out
+        k, v = self.peek()
+        if k == "op" and v in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            return BinaryOp(v, e, self.add_expr())
+        return e
+
+    def add_expr(self) -> Expr:
+        e = self.mul_expr()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("+", "-"):
+                self.next()
+                e = BinaryOp(v, e, self.mul_expr())
+            else:
+                return e
+
+    def mul_expr(self) -> Expr:
+        e = self.unary_expr()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ("*", "/", "%"):
+                self.next()
+                e = BinaryOp(v, e, self.unary_expr())
+            else:
+                return e
+
+    def unary_expr(self) -> Expr:
+        if self.eat_op("-"):
+            return UnaryOp("-", self.unary_expr())
+        self.eat_op("+")
+        return self.primary()
+
+    def primary(self) -> Expr:
+        k, v = self.peek()
+        if k == "num":
+            self.next()
+            return Literal(float(v) if "." in v else int(v))
+        if k == "str":
+            self.next()
+            return Literal(v)
+        if self.eat_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if k != "id":
+            raise SqlError(f"unexpected token {v!r}")
+        upper = v.upper()
+        if upper == "CASE":
+            return self.case_when()
+        if upper == "CAST":
+            self.next()
+            self.expect_op("(")
+            inner = self.expr()
+            self.expect_kw("AS")
+            tk, tv = self.next()
+            if tk != "id":
+                raise SqlError("expected type after CAST(expr AS")
+            self.expect_op(")")
+            return Cast(inner, tv)
+        if upper == "TRUE":
+            self.next()
+            return Literal(True)
+        if upper == "FALSE":
+            self.next()
+            return Literal(False)
+        if upper == "NULL":
+            self.next()
+            return Literal(None)
+        self.next()
+        # function call?
+        if self.eat_op("("):
+            if upper in _AGG_FUNCS:
+                distinct = self.eat_kw("DISTINCT")
+                if self.eat_op("*"):
+                    self.expect_op(")")
+                    return AggCall("count", None, distinct)
+                arg = self.expr()
+                self.expect_op(")")
+                return AggCall(upper.lower(), arg, distinct)
+            args: list[Expr] = []
+            if not self.eat_op(")"):
+                args.append(self.expr())
+                while self.eat_op(","):
+                    args.append(self.expr())
+                self.expect_op(")")
+            return FuncCall(upper, tuple(args))
+        # qualified name t.col -> col (single-table queries)
+        if self.eat_op("."):
+            ck, cv = self.next()
+            if ck != "id":
+                raise SqlError("expected column after '.'")
+            return Column(cv)
+        return Column(v)
+
+    def case_when(self) -> Expr:
+        self.expect_kw("CASE")
+        branches = []
+        while self.eat_kw("WHEN"):
+            cond = self.expr()
+            self.expect_kw("THEN")
+            branches.append((cond, self.expr()))
+        default = self.expr() if self.eat_kw("ELSE") else None
+        self.expect_kw("END")
+        if not branches:
+            raise SqlError("CASE needs at least one WHEN")
+        return CaseWhen(tuple(branches), default)
+
+
+def parse(sql: str) -> SelectStmt:
+    p = _Parser(sql)
+    stmt = p.parse_select()
+    if p.peek()[0] != "eof":
+        raise SqlError(f"trailing tokens at {p.peek()[1]!r}")
+    return stmt

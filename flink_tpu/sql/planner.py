@@ -1,0 +1,490 @@
+"""SQL planner: SelectStmt -> DataStream pipeline.
+
+The analog of the reference's planner + codegen chain (flink-table-planner
+delegation/PlannerBase.scala:170 translate -> ExecNode graph -> Janino
+codegen), collapsed: "codegen" here is compiling expressions to vectorized
+column closures (expressions.compile_expr) and picking operators —
+
+* stateless SELECT/WHERE      -> one BatchFnOperator (fused by chaining,
+  reference StreamExecCalc)
+* GROUP BY window_start/end over a window TVF
+                              -> keyBy + window aggregation, lowered to the
+  device slice-window operator when eligible (reference
+  StreamExecWindowAggregate -> SliceSharedWindowAggProcessor)
+* plain GROUP BY              -> GroupAggOperator changelog aggregation
+  (reference StreamExecGroupAggregate -> GroupAggFunction)
+* ORDER BY <agg> DESC LIMIT n over a changelog -> host TopN operator
+  (reference StreamExecRank)
+
+Aggregate inputs and group keys are materialized as generated columns
+(``__agg0__``, ...) by a projection ahead of the exchange, which is what the
+two-phase local/global split needs (reference StreamExecLocalGroupAggregate).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..api.datastream import DataStream
+from ..core.records import RecordBatch, Schema
+from ..runtime.operators.simple import BatchFnOperator
+from ..window.assigners import (
+    SlidingEventTimeWindows, TumblingEventTimeWindows,
+)
+from . import rowkind as rk
+from .expressions import (
+    AggCall, Column, Expr, ExprError, Star, collect_aggs, collect_columns,
+    compile_expr,
+)
+from .group_agg import GroupAggOperator, SqlAggSpec
+from .parser import SelectStmt, TableRef, WindowTVF
+from .topn import TopNOperator
+
+__all__ = ["plan", "PlanError"]
+
+WINDOW_COLS = ("window_start", "window_end")
+
+
+class PlanError(ValueError):
+    pass
+
+
+def plan(stmt: SelectStmt, resolve_table, env) -> DataStream:
+    """Translate ``stmt`` onto DataStream ops. ``resolve_table(name)``
+    returns the registered catalog entry's (DataStream, Schema)."""
+    return _Planner(resolve_table, env).plan_select(stmt)
+
+
+class _Planner:
+    def __init__(self, resolve_table, env):
+        self.resolve = resolve_table
+        self.env = env
+
+    # -- FROM --------------------------------------------------------------
+    def plan_from(self, from_) -> tuple[DataStream, Schema, Optional[WindowTVF]]:
+        if isinstance(from_, TableRef):
+            ds, schema = self.resolve(from_.name)
+            return ds, schema, None
+        if isinstance(from_, WindowTVF):
+            ds, schema, inner_tvf = self.plan_from(from_.table)
+            if inner_tvf is not None:
+                raise PlanError("nested window TVFs are not supported")
+            if from_.time_col not in schema:
+                raise PlanError(
+                    f"DESCRIPTOR column {from_.time_col!r} not in table")
+            return ds, schema, from_
+        if isinstance(from_, SelectStmt):
+            sub = self.plan_select(from_)
+            if sub._sql_schema is None:
+                raise PlanError("subquery output schema unknown")
+            return sub, sub._sql_schema, None
+        raise PlanError(f"unsupported FROM clause {from_!r}")
+
+    # -- SELECT ------------------------------------------------------------
+    def plan_select(self, stmt: SelectStmt) -> DataStream:
+        ds, schema, tvf = self.plan_from(stmt.from_)
+
+        # hoist aggregates from select items + having
+        agg_calls: list[AggCall] = []
+        for item in stmt.items:
+            if not isinstance(item.expr, Star):
+                collect_aggs(item.expr, agg_calls)
+        if stmt.having is not None:
+            collect_aggs(stmt.having, agg_calls)
+
+        if tvf is not None or stmt.group_by or agg_calls:
+            out = self.plan_aggregate(stmt, ds, schema, tvf, agg_calls)
+        else:
+            out = self.plan_calc(stmt, ds, schema)
+        out = self.plan_order_limit(stmt, out)
+        return out
+
+    # -- stateless calc (project + filter) ---------------------------------
+    def plan_calc(self, stmt: SelectStmt, ds: DataStream,
+                  schema: Schema) -> DataStream:
+        where_fn = (compile_expr(stmt.where)
+                    if stmt.where is not None else None)
+        out_fields, item_fns = self._select_fns(stmt.items, schema)
+        out_schema = Schema(out_fields)
+
+        def calc(batch: RecordBatch) -> Optional[RecordBatch]:
+            cols, n = dict(batch.columns), batch.n
+            ts = batch.timestamps
+            if where_fn is not None:
+                mask = where_fn(cols, n).astype(bool)
+                if not mask.all():
+                    idx = np.flatnonzero(mask)
+                    cols = {k: v[idx] for k, v in cols.items()}
+                    ts = ts[idx]
+                    n = len(idx)
+            out_cols = {name: np.asarray(fn(cols, n))
+                        for name, fn in item_fns}
+            return RecordBatch(out_schema, out_cols, ts)
+
+        out = ds.transform("Calc", lambda: BatchFnOperator(calc, "Calc"))
+        out._sql_schema = out_schema
+        return out
+
+    def _select_fns(self, items, schema: Schema,
+                    agg_slots: Optional[dict] = None):
+        """[(out_name, fn)] + schema fields for the select list."""
+        out_fields: list[tuple[str, Any]] = []
+        fns: list[tuple[str, Any]] = []
+        for i, item in enumerate(items):
+            if isinstance(item.expr, Star):
+                for f in schema.fields:
+                    name = f.name
+                    fns.append((name,
+                                (lambda nm: lambda cols, n: cols[nm])(name)))
+                    out_fields.append((name, f.dtype))
+                continue
+            name = item.alias or _default_name(item.expr, i)
+            fn = compile_expr(item.expr, agg_slots)
+            fns.append((name, fn))
+            out_fields.append((name, _infer_dtype(item.expr, schema)))
+        return out_fields, fns
+
+    # -- aggregation -------------------------------------------------------
+    def plan_aggregate(self, stmt: SelectStmt, ds: DataStream, schema: Schema,
+                       tvf: Optional[WindowTVF],
+                       agg_calls: list[AggCall]) -> DataStream:
+        group_exprs = list(stmt.group_by)
+        window_group = []
+        if tvf is not None:
+            window_group = [g for g in group_exprs
+                            if isinstance(g, Column)
+                            and g.name in WINDOW_COLS]
+            group_exprs = [g for g in group_exprs
+                           if not (isinstance(g, Column)
+                                   and g.name in WINDOW_COLS)]
+            if len(window_group) == 0:
+                raise PlanError(
+                    "window TVF queries must GROUP BY window_start/"
+                    "window_end")
+
+        # project: key columns + agg input columns (+ time for windows)
+        key_names: list[str] = []
+        key_fns = []
+        for i, g in enumerate(group_exprs):
+            if isinstance(g, Column):
+                key_names.append(g.name)
+                key_fns.append(None)
+            else:
+                key_names.append(f"__key{i}__")
+                key_fns.append(compile_expr(g))
+        agg_specs: list[SqlAggSpec] = []
+        agg_in_fns = []
+        for i, call in enumerate(agg_calls):
+            if call.arg is None:
+                agg_specs.append(SqlAggSpec("count", None, f"__out{i}__"))
+                agg_in_fns.append(None)
+            else:
+                in_name = (call.arg.name if isinstance(call.arg, Column)
+                           else f"__agg{i}__")
+                agg_specs.append(SqlAggSpec(call.kind, in_name,
+                                            f"__out{i}__", call.distinct))
+                agg_in_fns.append(None if isinstance(call.arg, Column)
+                                  else compile_expr(call.arg))
+
+        where_fn = (compile_expr(stmt.where)
+                    if stmt.where is not None else None)
+        time_col = tvf.time_col if tvf is not None else None
+        pre_fields: list[tuple[str, Any]] = []
+        for name, g in zip(key_names, group_exprs):
+            pre_fields.append(
+                (name, schema.field(name).dtype if name in schema
+                 else _infer_dtype(g, schema)))
+        for spec, call in zip(agg_specs, agg_calls):
+            if spec.field is not None:
+                pre_fields.append(
+                    (spec.field, schema.field(spec.field).dtype
+                     if spec.field in schema
+                     else _infer_dtype(call.arg, schema)))
+        seen = set()
+        pre_fields = [(n, d) for n, d in pre_fields
+                      if not (n in seen or seen.add(n))]
+        pre_schema = Schema(pre_fields)
+
+        def pre_project(batch: RecordBatch) -> Optional[RecordBatch]:
+            cols, n = dict(batch.columns), batch.n
+            ts = batch.timestamps
+            if time_col is not None:
+                ts = cols[time_col].astype(np.int64)
+            if where_fn is not None:
+                mask = where_fn(cols, n).astype(bool)
+                idx = np.flatnonzero(mask)
+                cols = {k: v[idx] for k, v in cols.items()}
+                ts = ts[idx]
+                n = len(idx)
+            for name, fn in zip(key_names, key_fns):
+                if fn is not None:
+                    cols[name] = np.asarray(fn(cols, n))
+            for spec, fn in zip(agg_specs, agg_in_fns):
+                if fn is not None:
+                    cols[spec.field] = np.asarray(fn(cols, n))
+            out_cols = {f.name: cols[f.name] for f in pre_schema.fields}
+            return RecordBatch(pre_schema, out_cols, ts)
+
+        projected = ds.transform(
+            "PreProject", lambda: BatchFnOperator(pre_project, "PreProject"))
+
+        if tvf is not None:
+            agged, agg_schema = self._window_agg(
+                projected, pre_schema, tvf, key_names, agg_specs)
+        else:
+            agged, agg_schema = self._group_agg(
+                projected, pre_schema, key_names, agg_specs)
+
+        return self._post_project(stmt, agged, agg_schema, group_exprs,
+                                  key_names, agg_calls, agg_specs,
+                                  window=tvf is not None)
+
+    def _group_agg(self, ds: DataStream, pre_schema: Schema,
+                   key_names: list[str], agg_specs: list[SqlAggSpec]):
+        if not key_names:
+            # global aggregation: single pseudo key
+            key_names = ["__global__"]
+
+            def add_global(batch: RecordBatch):
+                cols = dict(batch.columns)
+                cols["__global__"] = np.zeros(batch.n, np.int64)
+                schema = Schema([("__global__", np.int64)]
+                                + [(f.name, f.dtype)
+                                   for f in batch.schema.fields])
+                return RecordBatch(schema, cols, batch.timestamps)
+
+            ds = ds.transform(
+                "GlobalKey", lambda: BatchFnOperator(add_global, "GlobalKey"))
+            keyed = ds.key_by(lambda row: 0)
+        elif len(key_names) == 1:
+            keyed = ds.key_by(key_names[0])
+        else:
+            key_idx = [pre_schema.index_of(n) for n in key_names]
+            keyed = ds.key_by(
+                lambda row, _idx=tuple(key_idx): tuple(row[i] for i in _idx))
+        specs = list(agg_specs)
+        names = list(key_names)
+        out = keyed._one_input(
+            "GroupAggregate",
+            lambda: GroupAggOperator(names, specs),
+            key_extractor=keyed.key_extractor)
+        out_schema = Schema(
+            [(n, np.float64 if n.startswith("__key") else object)
+             for n in key_names]
+            + [(s.out_name, np.float64) for s in agg_specs]
+            + [(rk.ROWKIND_COLUMN, np.int8)])
+        return out, out_schema
+
+    def _window_agg(self, ds: DataStream, pre_schema: Schema,
+                    tvf: WindowTVF, key_names: list[str],
+                    agg_specs: list[SqlAggSpec]):
+        if len(key_names) != 1:
+            raise PlanError(
+                "window aggregation currently needs exactly one non-window "
+                "group key (matches the Nexmark shapes); got "
+                f"{key_names or 'none'}")
+        if tvf.kind == "TUMBLE":
+            assigner = TumblingEventTimeWindows.of(tvf.size_ms)
+        elif tvf.kind == "HOP":
+            assigner = SlidingEventTimeWindows.of(tvf.size_ms, tvf.slide_ms)
+        else:
+            raise PlanError(f"{tvf.kind} windows not supported yet")
+        keyed = ds.key_by(key_names[0])
+        windowed = keyed.window(assigner)
+        from ..runtime.operators.device_window import AggSpec as DevAggSpec
+        dev_specs = [
+            DevAggSpec(s.kind, s.field, out_name=s.out_name)
+            for s in agg_specs]
+        key_field = pre_schema.field(key_names[0])
+        from ..core.config import StateOptions
+        use_device = (self.env.config.get(StateOptions.BACKEND) == "tpu"
+                      and key_field.is_numeric
+                      and np.issubdtype(np.dtype(key_field.dtype),
+                                        np.integer)
+                      and assigner.pane_size is not None)
+        out_schema = Schema(
+            [(key_names[0], key_field.dtype),
+             ("window_start", np.int64), ("window_end", np.int64)]
+            + [(s.out_name, np.float64) for s in agg_specs])
+        if use_device:
+            out = windowed.device_aggregate(dev_specs,
+                                            name="WindowAggregate")
+        else:
+            out = self._host_window_agg(windowed, pre_schema, key_names[0],
+                                        agg_specs, out_schema)
+        return out, out_schema
+
+    def _host_window_agg(self, windowed, pre_schema: Schema, key_name: str,
+                         agg_specs: list[SqlAggSpec],
+                         out_schema: Schema):
+        from ..core.functions import AggregateFunction
+
+        idx = {f.name: i for i, f in enumerate(pre_schema.fields)}
+        specs = list(agg_specs)
+        single = len(pre_schema) == 1
+
+        class _Composite(AggregateFunction):
+            def create_accumulator(self):
+                return [(0.0, 0) if s.kind == "avg"
+                        else (0 if s.kind in ("count", "sum")
+                              else None)
+                        for s in specs]
+
+            def add(self, value, acc):
+                row = (value,) if single else value
+                out = []
+                for s, a in zip(specs, acc):
+                    v = None if s.field is None else row[idx[s.field]]
+                    if s.kind == "count":
+                        out.append(a + (1 if s.field is None
+                                        else (v is not None)))
+                    elif s.kind == "sum":
+                        out.append(a + v)
+                    elif s.kind == "avg":
+                        out.append((a[0] + v, a[1] + 1))
+                    elif s.kind == "min":
+                        out.append(v if a is None else min(a, v))
+                    else:
+                        out.append(v if a is None else max(a, v))
+                return out
+
+            def merge(self, a, b):
+                out = []
+                for s, x, y in zip(specs, a, b):
+                    if s.kind in ("count", "sum"):
+                        out.append(x + y)
+                    elif s.kind == "avg":
+                        out.append((x[0] + y[0], x[1] + y[1]))
+                    elif s.kind == "min":
+                        out.append(y if x is None else
+                                   (x if y is None else min(x, y)))
+                    else:
+                        out.append(y if x is None else
+                                   (x if y is None else max(x, y)))
+                return out
+
+            def get_result(self, acc):
+                out = []
+                for s, a in zip(specs, acc):
+                    if s.kind == "avg":
+                        out.append(a[0] / a[1] if a[1] else 0.0)
+                    else:
+                        out.append(a)
+                return out
+
+        def window_fn(key, window, result):
+            yield (key, window.start, window.end) + tuple(result)
+
+        return windowed._build("WindowAggregate", aggregate=_Composite(),
+                               window_fn=window_fn, out_schema=out_schema)
+
+    # -- post-aggregation projection --------------------------------------
+    def _post_project(self, stmt: SelectStmt, ds: DataStream,
+                      agg_schema: Schema, group_exprs, key_names,
+                      agg_calls, agg_specs, window: bool) -> DataStream:
+        agg_slots = {call: spec.out_name
+                     for call, spec in zip(agg_calls, agg_specs)}
+        # group-by expressions are addressable by their key column name
+        rewrites: dict[Expr, str] = {}
+        for g, name in zip(group_exprs, key_names):
+            rewrites[g] = name
+
+        def rewrite(e: Expr) -> Expr:
+            if e in rewrites:
+                return Column(rewrites[e])
+            return e
+
+        items = [type(it)(rewrite(it.expr), it.alias) if not
+                 isinstance(it.expr, Star) else it for it in stmt.items]
+        having_fn = None
+        if stmt.having is not None:
+            having_fn = compile_expr(rewrite(stmt.having), agg_slots)
+        out_fields, item_fns = self._select_fns(items, agg_schema, agg_slots)
+        has_rowkind = rk.ROWKIND_COLUMN in agg_schema
+        if has_rowkind and not any(n == rk.ROWKIND_COLUMN
+                                   for n, _ in out_fields):
+            out_fields = out_fields + [(rk.ROWKIND_COLUMN, np.int8)]
+            item_fns = item_fns + [(rk.ROWKIND_COLUMN,
+                                    lambda cols, n: cols[rk.ROWKIND_COLUMN])]
+        out_schema = Schema(out_fields)
+
+        def post(batch: RecordBatch) -> Optional[RecordBatch]:
+            cols, n = dict(batch.columns), batch.n
+            ts = batch.timestamps
+            if having_fn is not None:
+                mask = having_fn(cols, n).astype(bool)
+                idx = np.flatnonzero(mask)
+                cols = {k: v[idx] for k, v in cols.items()}
+                ts = ts[idx]
+                n = len(idx)
+            out_cols = {name: np.asarray(fn(cols, n))
+                        for name, fn in item_fns}
+            return RecordBatch(out_schema, out_cols, ts)
+
+        out = ds.transform("PostProject",
+                           lambda: BatchFnOperator(post, "PostProject"))
+        out._sql_schema = out_schema
+        return out
+
+    # -- ORDER BY / LIMIT --------------------------------------------------
+    def plan_order_limit(self, stmt: SelectStmt,
+                         ds: DataStream) -> DataStream:
+        if not stmt.order_by and stmt.limit is None:
+            return ds
+        if not stmt.order_by:
+            raise PlanError("LIMIT without ORDER BY is non-deterministic "
+                            "on streams; add ORDER BY")
+        schema = getattr(ds, "_sql_schema", None)
+        if schema is None:
+            raise PlanError("ORDER BY needs a known schema")
+        # resolve order expressions against the select list: an expression
+        # that IS a select item (e.g. ORDER BY SUM(v) with SUM(v) selected)
+        # sorts by that item's output column
+        out_names: dict[Expr, str] = {}
+        for i, item in enumerate(stmt.items):
+            if not isinstance(item.expr, Star):
+                out_names[item.expr] = (item.alias
+                                        or _default_name(item.expr, i))
+        sort_fns = []
+        for o in stmt.order_by:
+            expr = o.expr
+            if expr in out_names:
+                expr = Column(out_names[expr])
+            elif isinstance(expr, Column) and expr.name not in schema:
+                raise PlanError(f"ORDER BY column {expr.name!r} is not in "
+                                "the select list")
+            sort_fns.append((compile_expr(expr), o.descending))
+        limit = stmt.limit
+        if limit is None:
+            raise PlanError("streaming ORDER BY requires LIMIT (Top-N)")
+        out = ds.global_().transform(
+            "TopN",
+            lambda: TopNOperator(schema, sort_fns, limit),
+            parallelism=1)
+        out._sql_schema = schema
+        return out
+
+
+def _default_name(e: Expr, i: int) -> str:
+    if isinstance(e, Column):
+        return e.name
+    if isinstance(e, AggCall):
+        return f"{e.kind}_{e.arg.name}" if isinstance(e.arg, Column) \
+            else e.kind
+    return f"EXPR{i}"
+
+
+def _infer_dtype(e: Expr, schema: Schema):
+    """Best-effort output dtype for a select expression."""
+    if isinstance(e, Column) and e.name in schema:
+        return schema.field(e.name).dtype
+    if isinstance(e, AggCall):
+        return np.float64
+    cols: set[str] = set()
+    collect_columns(e, cols)
+    if cols and all(c in schema and schema.field(c).dtype is object
+                    for c in cols):
+        return object
+    return np.float64

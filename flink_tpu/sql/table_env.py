@@ -1,0 +1,141 @@
+"""TableEnvironment: the SQL entry point.
+
+Analog of the reference's TableEnvironment
+(flink-table-api-java internal/TableEnvironmentImpl.java:145 —
+executeSql:727, executeInternal:839) fused with its
+StreamTableEnvironment bridge (from_data_stream/to_data_stream/
+to_changelog_stream): a catalog of named tables over DataStreams, a parser +
+planner, and result collection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..api.datastream import DataStream
+from ..api.environment import StreamExecutionEnvironment
+from ..core.records import RecordBatch, Schema
+from . import rowkind as rk
+from .parser import parse
+from .planner import PlanError, plan
+
+__all__ = ["TableEnvironment", "Table", "TableResult"]
+
+
+class Table:
+    """A named or derived table: a DataStream + schema pair."""
+
+    def __init__(self, t_env: "TableEnvironment", stream: DataStream,
+                 schema: Schema):
+        self._t_env = t_env
+        self.stream = stream
+        self.schema = schema
+
+    def to_data_stream(self) -> DataStream:
+        return self.stream
+
+    def execute(self, timeout: Optional[float] = 120.0) -> "TableResult":
+        return self._t_env._execute_table(self, timeout)
+
+
+class TableResult:
+    """Materialized query result (reference TableResult#collect)."""
+
+    def __init__(self, schema: Schema, rows: list):
+        self.schema = schema
+        self._rows = rows
+
+    def collect(self) -> list:
+        return list(self._rows)
+
+    def collect_final(self) -> list:
+        """Fold the changelog: apply +I/+U/-U/-D and return the final rows
+        (order of last insertion)."""
+        if rk.ROWKIND_COLUMN not in self.schema:
+            return list(self._rows)
+        kind_idx = self.schema.index_of(rk.ROWKIND_COLUMN)
+        alive: dict[tuple, int] = {}
+        order: list[tuple] = []
+        for row in self._rows:
+            data = tuple(v for i, v in enumerate(row) if i != kind_idx)
+            kind = row[kind_idx]
+            if kind in (int(rk.UPDATE_BEFORE), int(rk.DELETE)):
+                m = alive.get(data, 0) - 1
+                if m <= 0:
+                    alive.pop(data, None)
+                else:
+                    alive[data] = m
+            else:
+                alive[data] = alive.get(data, 0) + 1
+                order.append(data)
+        seen: set = set()
+        out: list[tuple] = []
+        for data in reversed(order):
+            if data in alive and data not in seen:
+                out.extend([data] * alive[data])
+                seen.add(data)
+        out.reverse()
+        return out
+
+    def print(self) -> None:
+        names = self.schema.names
+        print(" | ".join(names))
+        for row in self._rows:
+            print(" | ".join(str(v) for v in row))
+
+
+class TableEnvironment:
+    def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
+        self.env = env or StreamExecutionEnvironment()
+        self._catalog: dict[str, tuple[DataStream, Schema]] = {}
+
+    @staticmethod
+    def create(env: Optional[StreamExecutionEnvironment] = None
+               ) -> "TableEnvironment":
+        return TableEnvironment(env)
+
+    # -- catalog -----------------------------------------------------------
+    def create_temporary_view(self, name: str, stream: DataStream,
+                              schema: Optional[Schema] = None) -> None:
+        """Register a DataStream as a queryable table
+        (reference createTemporaryView)."""
+        if schema is None:
+            schema = getattr(stream.transformation, "schema", None) \
+                or getattr(stream, "_sql_schema", None)
+            if schema is None:
+                raise ValueError(
+                    f"cannot infer schema for view {name!r}; pass schema=")
+        self._catalog[name.lower()] = (stream, schema)
+
+    def from_data_stream(self, stream: DataStream,
+                         schema: Optional[Schema] = None) -> Table:
+        if schema is None:
+            schema = getattr(stream.transformation, "schema", None)
+        return Table(self, stream, schema)
+
+    def _resolve(self, name: str) -> tuple[DataStream, Schema]:
+        entry = self._catalog.get(name.lower())
+        if entry is None:
+            raise PlanError(f"table {name!r} not found; registered: "
+                            f"{sorted(self._catalog)}")
+        return entry
+
+    # -- SQL ---------------------------------------------------------------
+    def sql_query(self, sql: str) -> Table:
+        stmt = parse(sql)
+        out = plan(stmt, self._resolve, self.env)
+        return Table(self, out, out._sql_schema)
+
+    def execute_sql(self, sql: str,
+                    timeout: Optional[float] = 120.0) -> TableResult:
+        return self.sql_query(sql).execute(timeout)
+
+    def _execute_table(self, table: Table,
+                       timeout: Optional[float]) -> TableResult:
+        from ..connectors.core import CollectSink
+        sink = CollectSink()
+        table.stream.add_sink(sink, "SqlCollect")
+        self.env.execute("sql-query", timeout=timeout)
+        return TableResult(table.schema, sink.rows)
