@@ -20,6 +20,7 @@ from ..runtime.channels import InputGate, LocalChannel
 from ..runtime.operators.base import OperatorChain, OperatorContext, Output
 from ..runtime.stream_task import (
     OneInputStreamTask, SourceStreamTask, StreamTask, TaskReporter,
+    TwoInputStreamTask,
 )
 from ..runtime.writer import RecordWriter
 
@@ -162,6 +163,24 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 if snapshot:
                     task.restore_state(snapshot)
                 job.source_tasks[task_id] = task
+            elif vertex.kind == "two_input":
+                # one gate per logical input (reference TwoInputStreamTask)
+                per_input: list[list] = [[], []]
+                for ei, e in in_edges:
+                    for s in range(len(channels[ei])):
+                        per_input[e.target_input].append(channels[ei][s][sub])
+                ops = [n.operator_factory() for n in vertex.chained_nodes]
+                task = TwoInputStreamTask.__new__(TwoInputStreamTask)
+                StreamTask.__init__(task, task_id, ctx, writers, job, config,
+                                    side_writers=side_writers)
+                task.gates = [InputGate(per_input[0], aligned=aligned),
+                              InputGate(per_input[1], aligned=aligned)]
+                task._gate_barrier = [None, None]
+                task.chain = OperatorChain(
+                    ops, ctx, task.make_tail_output(),
+                    side_outputs=_side_outputs_map(side_writers, metrics))
+                if snapshot:
+                    task.restore_state(snapshot)
             else:
                 # input gate over all in-edges' channels for this subtask
                 in_channels = []

@@ -20,9 +20,10 @@ from ..core.elements import Watermark
 from ..core.records import MIN_TIMESTAMP, RecordBatch, Schema
 from .operators.base import (
     CollectingOutput, OneInputOperator, OperatorChain, OperatorContext,
+    TwoInputOperator,
 )
 
-__all__ = ["OneInputOperatorTestHarness"]
+__all__ = ["OneInputOperatorTestHarness", "TwoInputOperatorTestHarness"]
 
 
 class OneInputOperatorTestHarness:
@@ -105,6 +106,101 @@ class OneInputOperatorTestHarness:
 
     def get_side_output(self, tag: str) -> list:
         return [r for b in self.output.side.get(tag, []) for r in b.iter_rows()]
+
+    def clear_output(self) -> None:
+        self.output.clear()
+
+    def close(self) -> None:
+        self.operator.finish()
+        self.operator.close()
+
+
+class TwoInputOperatorTestHarness:
+    """Drive one TwoInputOperator deterministically (reference
+    TwoInputStreamOperatorTestHarness): elements/watermarks per input,
+    snapshot/restore round-trips."""
+
+    def __init__(self, operator: TwoInputOperator,
+                 schema1: Optional[Schema] = None,
+                 schema2: Optional[Schema] = None,
+                 config: Optional[Configuration] = None,
+                 subtask_index: int = 0, parallelism: int = 1,
+                 max_parallelism: int = 128, task_name: str = "harness2"):
+        self.operator = operator
+        self.schemas = [schema1, schema2]
+        self.output = CollectingOutput()
+        self._now_ms = 0
+        self.ctx = OperatorContext(
+            task_name=task_name, subtask_index=subtask_index,
+            parallelism=parallelism, max_parallelism=max_parallelism,
+            config=config or Configuration(),
+            processing_time=lambda: self._now_ms)
+        self.chain = OperatorChain([operator], self.ctx, self.output,
+                                   side_outputs=None)
+        self._opened = False
+
+    def open(self, keyed_snapshots: Optional[list] = None,
+             operator_snapshot: Any = None) -> None:
+        self.operator.initialize_state(keyed_snapshots or [],
+                                       operator_snapshot)
+        self.operator.open()
+        self._opened = True
+
+    def _ensure_open(self) -> None:
+        if not self._opened:
+            self.open()
+
+    def _process(self, input_index: int, values: Sequence[Any],
+                 timestamps: Optional[Sequence[int]]) -> None:
+        self._ensure_open()
+        if self.schemas[input_index] is None:
+            self.schemas[input_index] = Schema.infer(values[0])
+        batch = RecordBatch.from_rows(
+            self.schemas[input_index], list(values),
+            list(timestamps) if timestamps else None)
+        if input_index == 0:
+            self.operator.process_batch1(batch)
+        else:
+            self.operator.process_batch2(batch)
+
+    def process_element1(self, value: Any,
+                         timestamp: int = MIN_TIMESTAMP) -> None:
+        self._process(0, [value], [timestamp])
+
+    def process_element2(self, value: Any,
+                         timestamp: int = MIN_TIMESTAMP) -> None:
+        self._process(1, [value], [timestamp])
+
+    def process_elements1(self, values, timestamps=None) -> None:
+        self._process(0, values, timestamps)
+
+    def process_elements2(self, values, timestamps=None) -> None:
+        self._process(1, values, timestamps)
+
+    def process_watermark1(self, ts: int) -> None:
+        self._ensure_open()
+        self.operator.process_watermark_n(0, Watermark(int(ts)))
+
+    def process_watermark2(self, ts: int) -> None:
+        self._ensure_open()
+        self.operator.process_watermark_n(1, Watermark(int(ts)))
+
+    def snapshot(self, checkpoint_id: int = 1) -> dict:
+        return self.operator.snapshot_state(checkpoint_id)
+
+    @staticmethod
+    def restored(operator_factory, snapshot: dict, **kwargs
+                 ) -> "TwoInputOperatorTestHarness":
+        h = TwoInputOperatorTestHarness(operator_factory(), **kwargs)
+        keyed = [snapshot["keyed"]] if snapshot.get("keyed") else []
+        h.open(keyed, snapshot.get("operator"))
+        return h
+
+    def get_output(self) -> list:
+        return self.output.rows()
+
+    def get_watermarks(self) -> list[int]:
+        return [w.timestamp for w in self.output.watermarks]
 
     def clear_output(self) -> None:
         self.output.clear()

@@ -238,8 +238,23 @@ class OperatorChain:
     def process_batch(self, batch: RecordBatch) -> None:
         self.head_one_input.process_batch(batch)
 
+    def process_batch_n(self, input_index: int, batch: RecordBatch) -> None:
+        """Route a batch to input 0/1 of a two-input head."""
+        head: TwoInputOperator = self.head  # type: ignore[assignment]
+        if input_index == 0:
+            head.process_batch1(batch)
+        else:
+            head.process_batch2(batch)
+
     def process_watermark(self, watermark: Watermark) -> None:
         self.head.process_watermark(watermark)
+
+    def process_watermark_n(self, input_index: int,
+                            watermark: Watermark) -> None:
+        if isinstance(self.head, TwoInputOperator):
+            self.head.process_watermark_n(input_index, watermark)
+        else:
+            self.head.process_watermark(watermark)
 
     def advance_processing_time(self, now_ms: int) -> None:
         for op in self.operators:

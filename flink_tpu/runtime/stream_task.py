@@ -36,7 +36,8 @@ from .channels import GateEvent, InputGate
 from .operators.base import OperatorChain, OperatorContext, Output
 from .writer import RecordWriter
 
-__all__ = ["StreamTask", "SourceStreamTask", "OneInputStreamTask", "TaskReporter"]
+__all__ = ["StreamTask", "SourceStreamTask", "OneInputStreamTask",
+           "TwoInputStreamTask", "TaskReporter"]
 
 
 class TaskReporter:
@@ -273,6 +274,99 @@ class SourceStreamTask(StreamTask):
                 out.emit_watermark(final_wm)
             self.broadcast_all(EndOfInput())
         self.reader.close()
+
+
+class TwoInputStreamTask(StreamTask):
+    """Two gates -> two-input head operator chain -> writers (reference
+    TwoInputStreamTask + StreamTwoInputProcessor). Each gate aligns barriers
+    over its own channels; the task snapshot fires only once BOTH gates have
+    delivered the barrier for the same checkpoint (the two-gate alignment of
+    SingleCheckpointBarrierHandler), holding back the already-aligned gate."""
+
+    def __init__(self, task_id: str, ctx: OperatorContext, gate1: InputGate,
+                 gate2: InputGate, chain: OperatorChain,
+                 writers: list[RecordWriter], reporter: TaskReporter,
+                 config: Optional[Configuration] = None):
+        super().__init__(task_id, ctx, writers, reporter, config)
+        self.gates = [gate1, gate2]
+        self.chain = chain
+        self._gate_barrier: list = [None, None]
+
+    def restore_state(self, snapshot: Optional[dict]) -> None:
+        if snapshot and snapshot.get("chain"):
+            self.chain.initialize_state(snapshot["chain"])
+
+    def _complete_barrier(self, barrier: CheckpointBarrier) -> None:
+        self._gate_barrier = [None, None]
+        self.broadcast_all(barrier)
+        snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
+        self.reporter.acknowledge_checkpoint(
+            self.task_id, barrier.checkpoint_id, snap)
+
+    def _on_barrier(self, gi: int, barrier: CheckpointBarrier) -> None:
+        self._gate_barrier[gi] = barrier
+        self._maybe_complete_barrier()
+
+    def _maybe_complete_barrier(self) -> None:
+        b0, b1 = self._gate_barrier
+        # an exhausted input never delivers barriers: don't wait on it
+        if b0 is not None and b1 is None and self.gates[1].all_ended():
+            b1 = b0
+        if b1 is not None and b0 is None and self.gates[0].all_ended():
+            b0 = b1
+        if b0 is None or b1 is None:
+            return  # hold the aligned gate (skipped in the poll loop)
+        if b0.checkpoint_id != b1.checkpoint_id:
+            # a newer checkpoint overtook on one side: adopt the newer one
+            newer = max(b0, b1, key=lambda b: b.checkpoint_id)
+            held = self._gate_barrier
+            self._gate_barrier = [None, None]
+            for g in (0, 1):
+                if held[g] is newer:
+                    self._gate_barrier[g] = newer
+            return
+        self._complete_barrier(b0)
+
+    def invoke(self) -> None:
+        self.chain.open()
+        rr = 0
+        while not self._cancelled.is_set():
+            self._drain_mailbox()
+            if any(b is not None for b in self._gate_barrier):
+                # the other input may have ended while a barrier was held
+                self._maybe_complete_barrier()
+            ev = gi = None
+            for off in range(2):
+                g = (rr + off) % 2
+                if self._gate_barrier[g] is not None:
+                    continue  # aligned, waiting for the other gate
+                ev = self.gates[g].poll()
+                if ev is not None:
+                    gi = g
+                    rr = 1 - g
+                    break
+            if ev is None:
+                if all(g.all_ended() for g in self.gates):
+                    break
+                self._advance_processing_time(self.chain)
+                time.sleep(0.0005)
+                continue
+            if ev.kind == "batch":
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.records_in.inc(ev.value.n)
+                self.chain.process_batch_n(gi, ev.value)
+            elif ev.kind == "watermark":
+                self.chain.process_watermark_n(gi, ev.value)
+            elif ev.kind == "barrier":
+                self._on_barrier(gi, ev.value)
+            elif ev.kind in ("latency", "idle"):
+                self.broadcast_all(ev.value)
+            self._advance_processing_time(self.chain)
+
+        if not self._cancelled.is_set():
+            self.chain.finish()
+            self.chain.close()
+            self.broadcast_all(EndOfInput())
 
 
 class OneInputStreamTask(StreamTask):

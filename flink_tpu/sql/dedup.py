@@ -1,0 +1,106 @@
+"""Streaming deduplication (reference table-runtime
+operators/deduplicate/{ProcTimeDeduplicateKeepFirstRowFunction,
+RowTimeDeduplicateFunction} behind StreamExecDeduplicate).
+
+keep="first": emit only the first row per key (append-only output).
+keep="last": emit a changelog — +I for a key's first row, then -U(prev)/+U
+(new) as later rows replace it (the reference's keep-last with
+generateUpdateBefore=true).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.keygroups import assign_to_key_group
+from ..core.records import RecordBatch, Schema
+from ..runtime.operators.base import OneInputOperator
+from . import rowkind as rk
+
+__all__ = ["DeduplicateOperator"]
+
+
+def _scalar(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+class DeduplicateOperator(OneInputOperator):
+    def __init__(self, key_index: int, keep: str = "first",
+                 name: str = "Deduplicate"):
+        super().__init__(name)
+        if keep not in ("first", "last"):
+            raise ValueError("keep must be 'first' or 'last'")
+        self.key_index = key_index
+        self.keep = keep
+        # kg -> key -> stored row (keep=last) / True (keep=first)
+        self._state: dict[int, dict[Any, Any]] = {}
+        self._out_schema: Optional[Schema] = None
+
+    def _ensure_schema(self, in_schema: Schema) -> Schema:
+        if self._out_schema is None:
+            fields = [(f.name, f.dtype) for f in in_schema.fields
+                      if f.name != rk.ROWKIND_COLUMN]
+            if self.keep == "last":
+                fields.append((rk.ROWKIND_COLUMN, np.int8))
+            self._out_schema = Schema(fields)
+        return self._out_schema
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        schema = self._ensure_schema(batch.schema)
+        names = [f.name for f in batch.schema.fields
+                 if f.name != rk.ROWKIND_COLUMN]
+        cols = [batch.column(n) for n in names]
+        kinds = (batch.column(rk.ROWKIND_COLUMN).astype(np.int8)
+                 if rk.ROWKIND_COLUMN in batch.schema
+                 else np.zeros(batch.n, np.int8))
+        ts_arr = batch.timestamps
+        out_rows, out_ts = [], []
+        for i in range(batch.n):
+            row = tuple(_scalar(c[i]) for c in cols)
+            key = row[self.key_index]
+            kg = assign_to_key_group(key, self.ctx.max_parallelism)
+            kmap = self._state.setdefault(kg, {})
+            ts = int(ts_arr[i])
+            retract = kinds[i] in (rk.UPDATE_BEFORE, rk.DELETE)
+            if self.keep == "first":
+                # keep-first assumes append-only input (like the reference's
+                # KeepFirstRowFunction); retractions are ignored
+                if not retract and key not in kmap:
+                    kmap[key] = True
+                    out_rows.append(row)
+                    out_ts.append(ts)
+            elif retract:
+                # retraction of the current row deletes the key's entry
+                if kmap.get(key) == row:
+                    del kmap[key]
+                    out_rows.append(row + (int(rk.DELETE),))
+                    out_ts.append(ts)
+            else:
+                prev = kmap.get(key)
+                kmap[key] = row
+                if prev is None:
+                    out_rows.append(row + (int(rk.INSERT),))
+                    out_ts.append(ts)
+                elif prev != row:
+                    out_rows.append(prev + (int(rk.UPDATE_BEFORE),))
+                    out_ts.append(ts)
+                    out_rows.append(row + (int(rk.UPDATE_AFTER),))
+                    out_ts.append(ts)
+        if out_rows:
+            self.output.emit(RecordBatch.from_rows(schema, out_rows, out_ts))
+
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        return {"keyed": {"backend": {"dedup": {
+            kg: dict(m) for kg, m in self._state.items()}}}}
+
+    def initialize_state(self, keyed_snapshots: list,
+                         operator_snapshot) -> None:
+        for snap in keyed_snapshots:
+            for kg, entries in snap.get("backend", {}).get("dedup",
+                                                           {}).items():
+                if kg in self.ctx.key_group_range:
+                    self._state.setdefault(kg, {}).update(entries)

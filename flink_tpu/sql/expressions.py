@@ -18,8 +18,31 @@ import numpy as np
 __all__ = [
     "Expr", "Column", "Literal", "BinaryOp", "UnaryOp", "FuncCall", "Cast",
     "CaseWhen", "Star", "AggCall", "compile_expr", "collect_columns",
-    "collect_aggs", "ExprError",
+    "collect_aggs", "rewrite_expr", "ExprError",
 ]
+
+
+def rewrite_expr(e: "Expr", fn) -> "Expr":
+    """Bottom-up structural rewrite: apply ``fn`` to every node after its
+    children have been rewritten (the planner's column-resolution hook)."""
+    if isinstance(e, BinaryOp):
+        e = BinaryOp(e.op, rewrite_expr(e.left, fn), rewrite_expr(e.right, fn))
+    elif isinstance(e, UnaryOp):
+        e = UnaryOp(e.op, rewrite_expr(e.operand, fn))
+    elif isinstance(e, FuncCall):
+        e = FuncCall(e.name, tuple(rewrite_expr(a, fn) for a in e.args))
+    elif isinstance(e, Cast):
+        e = Cast(rewrite_expr(e.operand, fn), e.type_name)
+    elif isinstance(e, CaseWhen):
+        e = CaseWhen(tuple((rewrite_expr(c, fn), rewrite_expr(v, fn))
+                           for c, v in e.branches),
+                     rewrite_expr(e.default, fn)
+                     if e.default is not None else None)
+    elif isinstance(e, AggCall):
+        e = AggCall(e.kind,
+                    rewrite_expr(e.arg, fn) if e.arg is not None else None,
+                    e.distinct)
+    return fn(e)
 
 
 class ExprError(ValueError):
@@ -34,6 +57,7 @@ class Expr:
 @dataclass(frozen=True)
 class Column(Expr):
     name: str
+    table: Optional[str] = None  # qualifier (alias) for multi-table queries
 
 
 @dataclass(frozen=True)

@@ -104,7 +104,7 @@ class GroupAggOperator(OneInputOperator):
     def process_batch(self, batch: RecordBatch) -> None:
         if batch.n == 0:
             return
-        keys, key_rows = self._group_ids(batch)
+        keys, single_key = self._group_ids(batch)
         kinds = (batch.column(rk.ROWKIND_COLUMN).astype(np.int8)
                  if rk.ROWKIND_COLUMN in batch.schema
                  else np.zeros(batch.n, np.int8))
@@ -112,7 +112,8 @@ class GroupAggOperator(OneInputOperator):
         sign = np.where((kinds == rk.UPDATE_BEFORE) | (kinds == rk.DELETE),
                         -1.0, 1.0)
 
-        uniq, inverse = np.unique(keys, return_inverse=True)
+        uniq, inverse = _unique_inverse(keys)
+        key_rows = [(k,) if single_key else k for k in uniq]
         order = np.argsort(inverse, kind="stable")
         sorted_inv = inverse[order]
         starts = np.searchsorted(sorted_inv, np.arange(len(uniq)))
@@ -143,6 +144,9 @@ class GroupAggOperator(OneInputOperator):
         ts_max = int(batch.timestamps.max())
         for gi, key in enumerate(uniq):
             key = key.item() if isinstance(key, np.generic) else key
+            key_rows[gi] = tuple(
+                v.item() if isinstance(v, np.generic) else v
+                for v in key_rows[gi])
             kg = self._key_group_for(key)
             kg_map = self._state.setdefault(kg, {})
             acc = kg_map.get(key)
@@ -197,24 +201,20 @@ class GroupAggOperator(OneInputOperator):
         self.output.emit(RecordBatch.from_rows(self._out_schema, rows, ts))
 
     # -- keys --------------------------------------------------------------
-    def _group_ids(self, batch: RecordBatch
-                   ) -> tuple[np.ndarray, list[tuple]]:
-        """Per-row group id array (hashable) + per-group key tuples."""
+    def _group_ids(self, batch: RecordBatch) -> tuple[np.ndarray, bool]:
+        """Per-row group key array (hashable) + whether it's a single
+        column (vs composite tuple keys)."""
         cols = [batch.column(c) for c in self._key_columns]
         if self._key_dtypes is None:
             self._key_dtypes = [batch.schema.field(c).dtype
                                 for c in self._key_columns]
         if len(cols) == 1:
-            keys = cols[0]
-            uniq = np.unique(keys)
-            rows = {_scalar(k): (_scalar(k),) for k in uniq}
-            return keys, [rows[_scalar(k)] for k in uniq]
+            return cols[0], True
         # composite key: build object array of tuples
         keys = np.empty(batch.n, dtype=object)
         for i in range(batch.n):
             keys[i] = tuple(_scalar(c[i]) for c in cols)
-        uniq = np.unique(keys)
-        return keys, [k for k in uniq]
+        return keys, False
 
     def _key_group_for(self, key: Any) -> int:
         return assign_to_key_group(key, self.ctx.max_parallelism)
@@ -234,6 +234,25 @@ class GroupAggOperator(OneInputOperator):
 
 def _scalar(v):
     return v.item() if isinstance(v, np.generic) else v
+
+
+def _unique_inverse(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """np.unique(return_inverse=True) that tolerates None / mixed-type
+    object keys (which break numpy's sort): dict-order first-seen unique."""
+    if keys.dtype != object:
+        return np.unique(keys, return_inverse=True)
+    index: dict = {}
+    uniq: list = []
+    inv = np.empty(len(keys), np.int64)
+    for i, k in enumerate(keys):
+        j = index.get(k)
+        if j is None:
+            j = index[k] = len(uniq)
+            uniq.append(k)
+        inv[i] = j
+    out = np.empty(len(uniq), dtype=object)
+    out[:] = uniq
+    return out, inv
 
 
 def _is_null(col: np.ndarray) -> np.ndarray:

@@ -26,8 +26,8 @@ from .expressions import (
     UnaryOp,
 )
 
-__all__ = ["parse", "SelectStmt", "TableRef", "WindowTVF", "OrderItem",
-           "SelectItem", "SqlError"]
+__all__ = ["parse", "SelectStmt", "TableRef", "JoinClause", "WindowTVF",
+           "OrderItem", "SelectItem", "SqlError"]
 
 _AGG_FUNCS = {"COUNT", "SUM", "MIN", "MAX", "AVG"}
 
@@ -50,6 +50,18 @@ class SelectItem:
 @dataclass
 class TableRef:
     name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class JoinClause:
+    """FROM a JOIN b ON cond (reference SqlJoin). ``kind`` in
+    INNER|LEFT|RIGHT|FULL; multi-way joins left-nest."""
+
+    kind: str
+    left: "FromClause"
+    right: "FromClause"
+    on: Expr
 
 
 @dataclass
@@ -76,9 +88,10 @@ class SelectStmt:
     having: Optional[Expr] = None
     order_by: list = field(default_factory=list)
     limit: Optional[int] = None
+    alias: Optional[str] = None  # derived-table alias: (SELECT ...) s
 
 
-FromClause = Union[TableRef, WindowTVF, SelectStmt]
+FromClause = Union[TableRef, WindowTVF, SelectStmt, "JoinClause"]
 
 
 _TOKEN_RE = re.compile(r"""
@@ -206,10 +219,32 @@ class _Parser:
         return OrderItem(e, desc)
 
     def from_clause(self) -> FromClause:
+        left = self.from_primary()
+        while True:
+            kind = None
+            if self.at_kw("JOIN"):
+                kind = "INNER"
+            elif self.at_kw("INNER"):
+                self.next()
+                kind = "INNER"
+            elif self.at_kw("LEFT", "RIGHT", "FULL"):
+                kind = self.next()[1].upper()
+                self.eat_kw("OUTER")
+            else:
+                return left
+            self.expect_kw("JOIN")
+            right = self.from_primary()
+            self.expect_kw("ON")
+            cond = self.expr()
+            left = JoinClause(kind, left, right, cond)
+
+    def from_primary(self) -> FromClause:
         if self.eat_op("("):
             inner = self.from_clause_inner()
             self.expect_op(")")
-            self.maybe_alias()
+            alias = self.maybe_alias()
+            if alias is not None and isinstance(inner, (TableRef, SelectStmt)):
+                inner.alias = alias
             return inner
         k, v = self.peek()
         if k == "id" and v.upper() in ("TUMBLE", "HOP", "CUMULATE"):
@@ -217,8 +252,7 @@ class _Parser:
         if k != "id":
             raise SqlError(f"expected table name, got {v!r}")
         self.next()
-        self.maybe_alias()
-        return TableRef(v)
+        return TableRef(v, self.maybe_alias())
 
     def from_clause_inner(self) -> FromClause:
         if self.at_kw("SELECT"):
@@ -236,13 +270,15 @@ class _Parser:
             raise SqlError(f"expected table reference, got {v!r}")
         return TableRef(v)
 
-    def maybe_alias(self) -> None:
+    def maybe_alias(self) -> Optional[str]:
         if self.eat_kw("AS"):
-            self.next()
-        elif (self.peek()[0] == "id"
-              and not self.at_kw("WHERE", "GROUP", "HAVING", "ORDER",
-                                 "LIMIT", "ON", "JOIN")):
-            self.next()
+            return self.next()[1]
+        if (self.peek()[0] == "id"
+                and not self.at_kw("WHERE", "GROUP", "HAVING", "ORDER",
+                                   "LIMIT", "ON", "JOIN", "INNER", "LEFT",
+                                   "RIGHT", "FULL", "OUTER")):
+            return self.next()[1]
+        return None
 
     def window_tvf(self) -> WindowTVF:
         kind = self.next()[1].upper()
@@ -415,12 +451,12 @@ class _Parser:
                     args.append(self.expr())
                 self.expect_op(")")
             return FuncCall(upper, tuple(args))
-        # qualified name t.col -> col (single-table queries)
+        # qualified name t.col: carry the qualifier for join resolution
         if self.eat_op("."):
             ck, cv = self.next()
             if ck != "id":
                 raise SqlError("expected column after '.'")
-            return Column(cv)
+            return Column(cv, table=v)
         return Column(v)
 
     def case_when(self) -> Expr:

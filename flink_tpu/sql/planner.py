@@ -35,11 +35,12 @@ from ..window.assigners import (
 )
 from . import rowkind as rk
 from .expressions import (
-    AggCall, Column, Expr, ExprError, Star, collect_aggs, collect_columns,
-    compile_expr,
+    AggCall, BinaryOp, Column, Expr, ExprError, Star, collect_aggs,
+    collect_columns, compile_expr, rewrite_expr,
 )
 from .group_agg import GroupAggOperator, SqlAggSpec
-from .parser import SelectStmt, TableRef, WindowTVF
+from .join import StreamingJoinOperator
+from .parser import JoinClause, SelectItem, SelectStmt, TableRef, WindowTVF
 from .topn import TopNOperator
 
 __all__ = ["plan", "PlanError"]
@@ -63,28 +64,184 @@ class _Planner:
         self.env = env
 
     # -- FROM --------------------------------------------------------------
-    def plan_from(self, from_) -> tuple[DataStream, Schema, Optional[WindowTVF]]:
+    def plan_from(self, from_) -> tuple[
+            DataStream, Schema, Optional[WindowTVF], dict]:
+        """Returns (stream, schema, window_tvf, qualifiers) where
+        ``qualifiers`` maps table alias -> {original column -> current
+        column name in the stream's schema} for qualified-name resolution."""
         if isinstance(from_, TableRef):
             ds, schema = self.resolve(from_.name)
-            return ds, schema, None
+            alias = from_.alias or from_.name
+            quals = {alias: {f.name: f.name for f in schema.fields}}
+            return ds, schema, None, quals
         if isinstance(from_, WindowTVF):
-            ds, schema, inner_tvf = self.plan_from(from_.table)
+            ds, schema, inner_tvf, quals = self.plan_from(from_.table)
             if inner_tvf is not None:
                 raise PlanError("nested window TVFs are not supported")
             if from_.time_col not in schema:
                 raise PlanError(
                     f"DESCRIPTOR column {from_.time_col!r} not in table")
-            return ds, schema, from_
+            return ds, schema, from_, quals
         if isinstance(from_, SelectStmt):
             sub = self.plan_select(from_)
             if sub._sql_schema is None:
                 raise PlanError("subquery output schema unknown")
-            return sub, sub._sql_schema, None
+            quals = ({from_.alias: {f.name: f.name
+                                    for f in sub._sql_schema.fields}}
+                     if from_.alias else {})
+            return sub, sub._sql_schema, None, quals
+        if isinstance(from_, JoinClause):
+            return self.plan_join(from_)
         raise PlanError(f"unsupported FROM clause {from_!r}")
+
+    # -- JOIN --------------------------------------------------------------
+    def plan_join(self, jc: JoinClause) -> tuple[
+            DataStream, Schema, None, dict]:
+        """Equi-join of two streams (reference StreamExecJoin ->
+        StreamingJoinOperator): key both sides by the equi columns, connect
+        through a two-input vertex; residual (non-equi) conjuncts become a
+        post-join filter (inner only). Columns colliding across sides are
+        renamed ``{alias}_{name}``; the other side's numeric fields are
+        promoted to float64 when nullable (outer joins pad with NaN/None)."""
+        lds, lschema, ltvf, lq = self.plan_from(jc.left)
+        rds, rschema, rtvf, rq = self.plan_from(jc.right)
+        if ltvf is not None or rtvf is not None:
+            raise PlanError("window TVFs cannot be direct join inputs; wrap "
+                            "the windowed aggregation in a subquery")
+        join_type = {"INNER": "inner", "LEFT": "left", "RIGHT": "right",
+                     "FULL": "full"}[jc.kind]
+
+        lnames = [f.name for f in lschema.fields
+                  if f.name != rk.ROWKIND_COLUMN]
+        rnames = [f.name for f in rschema.fields
+                  if f.name != rk.ROWKIND_COLUMN]
+        lprefix = next(iter(lq)) if len(lq) == 1 else "l"
+        rprefix = next(iter(rq)) if len(rq) == 1 else "r"
+        out_l = {n: n if n not in set(rnames) else f"{lprefix}_{n}"
+                 for n in lnames}
+        out_r = {n: n if n not in set(lnames) else f"{rprefix}_{n}"
+                 for n in rnames}
+        if set(out_l.values()) & set(out_r.values()):
+            raise PlanError("join column renaming collision; add aliases")
+
+        # resolve one ON-condition column to (side, renamed name)
+        def resolve_on(c: Column) -> tuple[str, str]:
+            if c.table is not None:
+                if c.table in lq and c.name in lq[c.table]:
+                    return "l", out_l[lq[c.table][c.name]]
+                if c.table in rq and c.name in rq[c.table]:
+                    return "r", out_r[rq[c.table][c.name]]
+                raise PlanError(f"cannot resolve {c.table}.{c.name} in ON")
+            in_l, in_r = c.name in out_l, c.name in out_r
+            if in_l and in_r:
+                raise PlanError(f"ambiguous column {c.name!r} in ON")
+            if in_l:
+                return "l", out_l[c.name]
+            if in_r:
+                return "r", out_r[c.name]
+            raise PlanError(f"unknown column {c.name!r} in ON")
+
+        equi: list[tuple[str, str]] = []   # (left col, right col), renamed
+        residual: list[Expr] = []
+        for conj in _conjuncts(jc.on):
+            if (isinstance(conj, BinaryOp) and conj.op == "="
+                    and isinstance(conj.left, Column)
+                    and isinstance(conj.right, Column)):
+                s1, n1 = resolve_on(conj.left)
+                s2, n2 = resolve_on(conj.right)
+                if s1 != s2:
+                    equi.append((n1, n2) if s1 == "l" else (n2, n1))
+                    continue
+            residual.append(conj)
+        if not equi:
+            raise PlanError("streaming join needs at least one equi "
+                            "condition a.x = b.y")
+        if residual and join_type != "inner":
+            raise PlanError("non-equi ON conditions are only supported for "
+                            "INNER joins")
+
+        l_nullable = join_type in ("right", "full")
+        r_nullable = join_type in ("left", "full")
+        renamed_l = self._rename_side(lds, lschema, out_l, "JoinLeftRename")
+        renamed_r = self._rename_side(rds, rschema, out_r, "JoinRightRename")
+
+        out_fields = (
+            [(out_l[n], _nullable_dtype(lschema.field(n).dtype, l_nullable))
+             for n in lnames]
+            + [(out_r[n], _nullable_dtype(rschema.field(n).dtype, r_nullable))
+               for n in rnames]
+            + [(rk.ROWKIND_COLUMN, np.int8)])
+        out_schema = Schema(out_fields)
+
+        lkey_names = [p[0] for p in equi]
+        rkey_names = [p[1] for p in equi]
+        lkey_idx = (lnames.index(_orig(out_l, lkey_names[0]))
+                    if len(equi) == 1
+                    else tuple(lnames.index(_orig(out_l, n))
+                               for n in lkey_names))
+        rkey_idx = (rnames.index(_orig(out_r, rkey_names[0]))
+                    if len(equi) == 1
+                    else tuple(rnames.index(_orig(out_r, n))
+                               for n in rkey_names))
+
+        lkeyed = (renamed_l.key_by(lkey_names[0]) if len(equi) == 1
+                  else renamed_l.key_by(
+                      lambda row, _i=lkey_idx: tuple(row[i] for i in _i)))
+        rkeyed = (renamed_r.key_by(rkey_names[0]) if len(equi) == 1
+                  else renamed_r.key_by(
+                      lambda row, _i=rkey_idx: tuple(row[i] for i in _i)))
+
+        n_l, n_r = len(lnames), len(rnames)
+        jt = join_type
+        joined = lkeyed.connect(rkeyed).transform(
+            "Join",
+            lambda: StreamingJoinOperator(jt, lkey_idx, rkey_idx,
+                                          out_schema, n_l, n_r))
+        if residual:
+            cond = residual[0]
+            for c in residual[1:]:
+                cond = BinaryOp("AND", cond, c)
+            cond = rewrite_expr(cond, lambda e: (
+                Column(resolve_on(e)[1]) if isinstance(e, Column) else e))
+            cond_fn = compile_expr(cond)
+
+            def filt(batch: RecordBatch):
+                mask = cond_fn(dict(batch.columns), batch.n).astype(bool)
+                idx = np.flatnonzero(mask)
+                return batch.take(idx)
+
+            joined = joined.transform(
+                "JoinFilter", lambda: BatchFnOperator(filt, "JoinFilter"))
+
+        quals: dict = {}
+        for q, m in lq.items():
+            quals[q] = {orig: out_l[cur] for orig, cur in m.items()
+                        if cur in out_l}
+        for q, m in rq.items():
+            quals[q] = {orig: out_r[cur] for orig, cur in m.items()
+                        if cur in out_r}
+        joined._sql_schema = out_schema
+        return joined, out_schema, None, quals
+
+    def _rename_side(self, ds: DataStream, schema: Schema,
+                     rename: dict, name: str) -> DataStream:
+        if all(k == v for k, v in rename.items()):
+            return ds
+        out_fields = [(rename.get(f.name, f.name), f.dtype)
+                      for f in schema.fields]
+        out_schema = Schema(out_fields)
+
+        def project(batch: RecordBatch):
+            cols = {rename.get(f.name, f.name): batch.column(f.name)
+                    for f in batch.schema.fields}
+            return RecordBatch(out_schema, cols, batch.timestamps)
+
+        return ds.transform(name, lambda: BatchFnOperator(project, name))
 
     # -- SELECT ------------------------------------------------------------
     def plan_select(self, stmt: SelectStmt) -> DataStream:
-        ds, schema, tvf = self.plan_from(stmt.from_)
+        ds, schema, tvf, quals = self.plan_from(stmt.from_)
+        stmt = _resolve_stmt(stmt, schema, quals)
 
         # hoist aggregates from select items + having
         agg_calls: list[AggCall] = []
@@ -107,6 +264,13 @@ class _Planner:
         where_fn = (compile_expr(stmt.where)
                     if stmt.where is not None else None)
         out_fields, item_fns = self._select_fns(stmt.items, schema)
+        # changelog input: pass the rowkind column through so downstream
+        # changelog consumers (TopN, sinks) keep retraction semantics
+        if (rk.ROWKIND_COLUMN in schema
+                and not any(n == rk.ROWKIND_COLUMN for n, _ in out_fields)):
+            out_fields = out_fields + [(rk.ROWKIND_COLUMN, np.int8)]
+            item_fns = item_fns + [(rk.ROWKIND_COLUMN,
+                                    lambda cols, n: cols[rk.ROWKIND_COLUMN])]
         out_schema = Schema(out_fields)
 
         def calc(batch: RecordBatch) -> Optional[RecordBatch]:
@@ -202,6 +366,16 @@ class _Planner:
                     (spec.field, schema.field(spec.field).dtype
                      if spec.field in schema
                      else _infer_dtype(call.arg, schema)))
+        # changelog input (e.g. aggregating over a join's output): carry the
+        # rowkind column so GroupAggOperator retracts correctly
+        changelog_in = rk.ROWKIND_COLUMN in schema
+        if changelog_in:
+            if tvf is not None:
+                raise PlanError(
+                    "window aggregation over a changelog (updating) input "
+                    "is not supported; aggregate before the window or use "
+                    "an append-only input")
+            pre_fields.append((rk.ROWKIND_COLUMN, np.int8))
         seen = set()
         pre_fields = [(n, d) for n, d in pre_fields
                       if not (n in seen or seen.add(n))]
@@ -465,6 +639,67 @@ class _Planner:
             parallelism=1)
         out._sql_schema = schema
         return out
+
+
+def _conjuncts(e: Expr) -> list:
+    if isinstance(e, BinaryOp) and e.op == "AND":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _orig(rename: dict, renamed: str) -> str:
+    for k, v in rename.items():
+        if v == renamed:
+            return k
+    raise KeyError(renamed)
+
+
+def _nullable_dtype(dtype, nullable: bool):
+    """Outer-join null padding: integer/bool columns become float64 (NaN
+    null), floats keep NaN, objects keep None."""
+    if not nullable or dtype is object:
+        return dtype
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        return dtype
+    return np.float64
+
+
+def _resolve_stmt(stmt: SelectStmt, schema: Schema,
+                  quals: dict) -> SelectStmt:
+    """Rewrite qualified columns (a.x) to their current schema names and
+    validate unqualified ones against the joined/renamed schema."""
+
+    def resolve(e: Expr) -> Expr:
+        if not isinstance(e, Column):
+            return e
+        if e.table is not None:
+            m = quals.get(e.table)
+            if m is None or e.name not in m:
+                raise PlanError(
+                    f"cannot resolve column {e.table}.{e.name}")
+            return Column(m[e.name])
+        if e.name in schema:
+            return e
+        hits = {m[e.name] for m in quals.values() if e.name in m}
+        if len(hits) == 1:
+            return Column(hits.pop())
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {e.name!r}")
+        return e  # window_start/window_end appear later; defer
+
+    def rw(e: Expr) -> Expr:
+        return rewrite_expr(e, resolve)
+
+    out = SelectStmt(
+        items=[it if isinstance(it.expr, Star)
+               else SelectItem(rw(it.expr), it.alias) for it in stmt.items],
+        from_=stmt.from_,
+        where=rw(stmt.where) if stmt.where is not None else None,
+        group_by=[rw(g) for g in stmt.group_by],
+        having=rw(stmt.having) if stmt.having is not None else None,
+        order_by=[type(o)(rw(o.expr), o.descending) for o in stmt.order_by],
+        limit=stmt.limit)
+    return out
 
 
 def _default_name(e: Expr, i: int) -> str:
