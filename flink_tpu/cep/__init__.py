@@ -1,0 +1,69 @@
+"""CEP: complex event processing over keyed streams.
+
+Analog of the reference's flink-cep library (CEP.java, PatternStream.java:
+``CEP.pattern(stream, pattern).select(fn)``). Patterns compile to an NFA
+(nfa.py) driven by the CepOperator per key in event-time order.
+
+Usage::
+
+    pat = (Pattern.begin("start").where(lambda e: e["v"] == 1)
+           .followed_by("end").where(lambda e: e["v"] == 2)
+           .within(10_000))
+    out = CEP.pattern(ds, pat, key="user") \
+             .select(lambda m: (m["start"][0]["user"],), out_schema)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.records import Schema
+from .nfa import NFA, Match, NO_SKIP, SKIP_PAST_LAST_EVENT
+from .operator import CepOperator
+from .pattern import MalformedPatternError, Pattern
+
+__all__ = ["CEP", "Pattern", "PatternStream", "Match", "NFA",
+           "MalformedPatternError", "NO_SKIP", "SKIP_PAST_LAST_EVENT",
+           "CepOperator"]
+
+
+class PatternStream:
+    def __init__(self, stream, pattern: Pattern, key: str,
+                 skip_strategy: str = NO_SKIP):
+        self.stream = stream
+        self.pattern = pattern
+        self.key = key
+        self.skip_strategy = skip_strategy
+
+    def with_skip_strategy(self, strategy: str) -> "PatternStream":
+        return PatternStream(self.stream, self.pattern, self.key, strategy)
+
+    def _build(self, select_fn, out_schema: Schema, flat: bool):
+        stages = self.pattern.compile()
+        within = self.pattern.within_ms
+        key = self.key
+        skip = self.skip_strategy
+        keyed = self.stream.key_by(key)
+
+        def factory():
+            return CepOperator(NFA(stages, within, skip), key, select_fn,
+                               out_schema, flat_select=flat)
+
+        out = keyed._one_input("CepOperator", factory,
+                               key_extractor=keyed.key_extractor)
+        out._sql_schema = out_schema
+        return out
+
+    def select(self, fn: Callable[[Match], tuple], out_schema: Schema):
+        """One output row per match (reference PatternSelectFunction)."""
+        return self._build(fn, out_schema, flat=False)
+
+    def flat_select(self, fn, out_schema: Schema):
+        """Zero or more output rows per match (PatternFlatSelectFunction)."""
+        return self._build(fn, out_schema, flat=True)
+
+
+class CEP:
+    @staticmethod
+    def pattern(stream, pattern: Pattern, key: str) -> PatternStream:
+        return PatternStream(stream, pattern, key)

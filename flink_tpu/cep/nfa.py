@@ -1,0 +1,279 @@
+"""NFA over one key's event sequence.
+
+Analog of the reference's NFA + SharedBuffer machinery (flink-cep
+nfa/NFA.java — computeNextStates with TAKE/IGNORE/PROCEED branching,
+nfa/aftermatch/AfterMatchSkipStrategy.java), reduced to an explicit
+partial-match list: each partial is (stage, count, captured events). The
+branching matrix implements the three consuming strategies (STRICT /
+SKIP_TILL_NEXT / SKIP_TILL_ANY) between stages and inside loops, greedy
+loops, optional stages, NOT-pattern guards, and the within() window.
+
+Host-side by design: conditions are arbitrary Python predicates, and CEP
+state is tiny compared to window/agg state. The batch path still amortizes —
+the operator buffers a whole micro-batch per key and advances the NFA once
+per event without any per-event operator dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+from .pattern import NDR, RELAXED, STRICT, Stage
+
+__all__ = ["NFA", "Match", "NO_SKIP", "SKIP_PAST_LAST_EVENT"]
+
+NO_SKIP = "no_skip"
+SKIP_PAST_LAST_EVENT = "skip_past_last_event"
+
+
+@dataclass(frozen=True)
+class Event:
+    seq: int
+    ts: int
+    data: dict
+
+
+@dataclass
+class Match:
+    """One complete match: pattern name -> list of event dicts."""
+
+    events: dict
+    start_ts: int
+    end_ts: int
+    last_seq: int
+    start_seq: int = 0
+
+    def __getitem__(self, name: str) -> list:
+        return self.events[name]
+
+
+@dataclass
+class _Partial:
+    stage: int                   # index into positive stage order
+    count: int                   # events taken in the current stage
+    taking: bool                 # loop still accepting (until/consecutive)
+    captured: tuple              # ((stage_idx, Event), ...)
+    start_ts: int
+    start_seq: int
+    ignored_since_advance: int   # events ignored since last take/proceed
+
+
+class NFA:
+    def __init__(self, stages: list, within_ms: Optional[int] = None,
+                 skip_strategy: str = NO_SKIP):
+        self.stages = stages
+        self.within_ms = within_ms
+        self.skip = skip_strategy
+        # positive stage indices in order; negatives act as guards between
+        self.pos: list[int] = [i for i, s in enumerate(stages)
+                               if not s.negated]
+        if not self.pos:
+            raise ValueError("pattern has no positive stages")
+
+    # -- helpers -----------------------------------------------------------
+    def _stage(self, pi: int) -> Stage:
+        return self.stages[self.pos[pi]]
+
+    def _guards_between(self, pi: int) -> list[Stage]:
+        """Negated stages between positive pi and positive pi+1 (or the
+        trailing negatives when pi is the last positive stage)."""
+        lo = self.pos[pi]
+        hi = (self.pos[pi + 1] if pi + 1 < len(self.pos)
+              else len(self.stages))
+        return [self.stages[i] for i in range(lo + 1, hi)
+                if self.stages[i].negated]
+
+    def _next_candidates(self, pi: int) -> list[int]:
+        """Positive stages reachable from pi by PROCEED, skipping optional
+        stages (each skipped stage must be optional)."""
+        out = []
+        j = pi + 1
+        while j < len(self.pos):
+            out.append(j)
+            if not self._stage(j).optional:
+                break
+            j += 1
+        return out
+
+    def _is_final(self, pi: int, count: int) -> bool:
+        if count < self._stage(pi).min_count:
+            return False
+        # all later positive stages must be optional
+        return all(self._stage(j).optional
+                   for j in range(pi + 1, len(self.pos)))
+
+    # -- core --------------------------------------------------------------
+    def advance(self, partials: list, event: Event
+                ) -> tuple[list, list]:
+        """One event through all partials + the start state. Returns
+        (new partials, matches)."""
+        out: list[_Partial] = []
+        matches: list[Match] = []
+        seen_match_keys: set = set()
+
+        def emit(p: _Partial) -> None:
+            key = tuple(e.seq for _, e in p.captured)
+            if key in seen_match_keys:
+                return
+            seen_match_keys.add(key)
+            ev_map: dict[str, list] = {}
+            for si, e in p.captured:
+                ev_map.setdefault(self.stages[si].name, []).append(e.data)
+            matches.append(Match(ev_map, p.start_ts, event.ts,
+                                 max(e.seq for _, e in p.captured),
+                                 p.start_seq))
+
+        def offer(p: _Partial) -> None:
+            """Register a successor; emit when it reaches a final state."""
+            if self._is_final(p.stage, p.count):
+                if self._guards_between(p.stage):
+                    # trailing NOT pattern: defer to timeout (pruning)
+                    out.append(p)
+                    return
+                emit(p)
+                s = self._stage(p.stage)
+                if p.taking and s.looping and (
+                        s.max_count is None or p.count < s.max_count):
+                    out.append(p)  # loop can still extend into longer matches
+            else:
+                out.append(p)
+
+        # existing partials
+        for p in partials:
+            if (self.within_ms is not None
+                    and event.ts - p.start_ts > self.within_ms):
+                self._flush_deferred(p, event.ts, emit_fn=matches)
+                continue  # timed out
+            out_branches = self._advance_one(p, event, emit_offer=offer)
+            out.extend(out_branches)
+
+        # start a new partial at the first positive stage (every event may
+        # begin a match — reference NFA start state self-loop)
+        first = self._stage(0)
+        start_candidates = [0] + ([] if not first.optional
+                                  else self._next_candidates(0))
+        for pi in start_candidates:
+            s = self._stage(pi)
+            if not s.negated and s.matches(event.data):
+                p = _Partial(pi, 1, True, ((self.pos[pi], event),),
+                             event.ts, event.seq, 0)
+                offer(p)
+                break  # only the first stage that matches starts the run
+
+        if self.skip == SKIP_PAST_LAST_EVENT and matches:
+            # keep the earliest-starting match, drop matches and partials
+            # overlapping it (reference AfterMatchSkipStrategy)
+            matches.sort(key=lambda m: m.start_seq)
+            kept: list[Match] = []
+            horizon = -1
+            for m in matches:
+                if m.start_seq > horizon:
+                    kept.append(m)
+                    horizon = m.last_seq
+            matches = kept
+            out = [p for p in out if p.start_seq > horizon]
+        return out, matches
+
+    def _advance_one(self, p: _Partial, event: Event, emit_offer) -> list:
+        """TAKE / PROCEED / IGNORE branching for one partial."""
+        s = self._stage(p.stage)
+        branches: list[_Partial] = []
+        e_matches = s.matches(event.data)
+
+        # until() stops the loop from taking (event not consumed)
+        taking = p.taking
+        if taking and s.until is not None and p.count >= 1 \
+                and s.until(event.data):
+            taking = False
+
+        can_take = (taking and e_matches
+                    and (s.max_count is None or p.count < s.max_count))
+        took = False
+        if can_take:
+            emit_offer(replace(
+                p, count=p.count + 1, taking=taking,
+                captured=p.captured + ((self.pos[p.stage], event),),
+                ignored_since_advance=0))
+            took = True
+
+        # PROCEED to following stage(s) once the current one is satisfied
+        proceeded = False
+        can_proceed = p.count >= s.min_count and not (s.greedy and can_take)
+        if can_proceed:
+            guards = self._guards_between(p.stage)
+            guard_hit = any(
+                g.matches(event.data)
+                and (g.contiguity != STRICT or p.ignored_since_advance == 0)
+                for g in guards)
+            if guard_hit:
+                return branches  # NOT pattern matched: path dies
+            for pj in self._next_candidates(p.stage):
+                nxt = self._stage(pj)
+                if nxt.matches(event.data):
+                    emit_offer(replace(
+                        p, stage=pj, count=1, taking=True,
+                        captured=p.captured + ((self.pos[pj], event),),
+                        ignored_since_advance=0))
+                    proceeded = True
+                    if nxt.contiguity != NDR:
+                        break
+
+        # IGNORE: keep waiting (contiguity-dependent)
+        in_loop = p.count >= 1
+        cont = s.inner_contiguity if in_loop else s.contiguity
+        ignore_ok = True
+        new_taking = taking
+        if in_loop:
+            if cont == STRICT and e_matches is False and taking:
+                new_taking = False  # consecutive(): loop broken, may proceed
+            if cont == RELAXED and took:
+                ignore_ok = False
+            # waiting for next stage is always allowed once min met, unless
+            # a strict next stage saw a non-matching event
+            if p.count >= s.min_count:
+                nxts = self._next_candidates(p.stage)
+                if nxts and self._stage(nxts[0]).contiguity == STRICT \
+                        and not took and not proceeded:
+                    ignore_ok = False
+        else:
+            if cont == STRICT and not took:
+                return branches  # strict start of stage: miss kills path
+            if cont == RELAXED and took:
+                ignore_ok = False
+        if ignore_ok and not (took and cont == RELAXED and not in_loop):
+            branches.append(replace(
+                p, taking=new_taking,
+                ignored_since_advance=p.ignored_since_advance + 1))
+        return branches
+
+    def _flush_deferred(self, p: _Partial, now_ts: int, emit_fn) -> None:
+        """A timed-out partial whose positive stages are complete and whose
+        only remaining obligation was a trailing NOT pattern matches at
+        timeout (reference notFollowedBy+within semantics)."""
+        if not self._is_final(p.stage, p.count):
+            return
+        if not self._guards_between(p.stage):
+            return
+        ev_map: dict[str, list] = {}
+        for si, e in p.captured:
+            ev_map.setdefault(self.stages[si].name, []).append(e.data)
+        end_ts = (p.start_ts + self.within_ms if self.within_ms is not None
+                  else now_ts)
+        emit_fn.append(Match(ev_map, p.start_ts, end_ts,
+                             max(e.seq for _, e in p.captured),
+                             p.start_seq))
+
+    def prune(self, partials: list, watermark_ts: int) -> tuple[list, list]:
+        """Drop partials whose within-window has passed; deferred
+        trailing-NOT matches fire here."""
+        if self.within_ms is None:
+            return partials, []
+        kept, matches = [], []
+        for p in partials:
+            if watermark_ts - p.start_ts > self.within_ms:
+                self._flush_deferred(p, p.start_ts + self.within_ms,
+                                     emit_fn=matches)
+            else:
+                kept.append(p)
+        return kept, matches

@@ -1,0 +1,127 @@
+"""CepOperator: keyed NFA pattern matching on a stream.
+
+Analog of the reference's CepOperator (flink-cep
+operator/CepOperator.java:82): events are buffered per key and processed in
+event-time order when the watermark passes them (the reference's event queue
++ onEventTime), partial matches live in keyed state, matched sequences are
+handed to a select function.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core.keygroups import assign_to_key_group
+from ..core.records import RecordBatch, Schema, scalar as _scalar
+from ..runtime.operators.base import OneInputOperator
+from .nfa import NFA, Event, Match
+
+__all__ = ["CepOperator"]
+
+
+class CepOperator(OneInputOperator):
+    """``select_fn(match: Match) -> row tuple`` (or an iterable of rows via
+    flat_select=True) produces the output; rows follow ``out_schema``."""
+
+    def __init__(self, nfa: NFA, key_column: str,
+                 select_fn: Callable[[Match], Any], out_schema: Schema,
+                 flat_select: bool = False, name: str = "Cep"):
+        super().__init__(name)
+        self.nfa = nfa
+        self.key_column = key_column
+        self.select_fn = select_fn
+        self.out_schema = out_schema
+        self.flat_select = flat_select
+        self._seq = itertools.count()
+        # kg -> key -> {"buffer": [Event], "partials": [_Partial]}
+        self._state: dict[int, dict[Any, dict]] = {}
+
+    def _key_state(self, key) -> dict:
+        kg = assign_to_key_group(key, self.ctx.max_parallelism)
+        return (self._state.setdefault(kg, {})
+                .setdefault(key, {"buffer": [], "partials": []}))
+
+    # -- data path ---------------------------------------------------------
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        names = [f.name for f in batch.schema.fields]
+        cols = [batch.column(n) for n in names]
+        keys = batch.column(self.key_column)
+        ts_arr = batch.timestamps
+        for i in range(batch.n):
+            data = {n: _scalar(c[i]) for n, c in zip(names, cols)}
+            ev = Event(next(self._seq), int(ts_arr[i]), data)
+            if ev.ts <= self.current_watermark:
+                continue  # late event: dropped (reference side-output TODO)
+            self._key_state(_scalar(keys[i]))["buffer"].append(ev)
+
+    def process_watermark(self, watermark) -> None:
+        self._fire_up_to(watermark.timestamp)
+        super().process_watermark(watermark)
+
+    def finish(self) -> None:
+        self._fire_up_to((1 << 62))
+
+    def _fire_up_to(self, wm_ts: int) -> None:
+        out_rows, out_ts = [], []
+        for kg_map in self._state.values():
+            for key in list(kg_map):
+                st = kg_map[key]
+                ready = [e for e in st["buffer"] if e.ts <= wm_ts]
+                if not ready and not st["partials"]:
+                    if not st["buffer"]:
+                        del kg_map[key]  # fully drained: free the key
+                    continue
+                st["buffer"] = [e for e in st["buffer"] if e.ts > wm_ts]
+                ready.sort(key=lambda e: (e.ts, e.seq))
+                partials = st["partials"]
+                for ev in ready:
+                    partials, matches = self.nfa.advance(partials, ev)
+                    self._collect(matches, ev.ts, out_rows, out_ts)
+                partials, timed_out = self.nfa.prune(partials, wm_ts)
+                self._collect(timed_out, wm_ts, out_rows, out_ts)
+                st["partials"] = partials
+                if not partials and not st["buffer"]:
+                    del kg_map[key]
+        if out_rows:
+            self.output.emit(RecordBatch.from_rows(
+                self.out_schema, out_rows, out_ts))
+
+    def _collect(self, matches: list, ts: int, out_rows: list,
+                 out_ts: list) -> None:
+        for m in matches:
+            if self.flat_select:
+                for row in self.select_fn(m):
+                    out_rows.append(tuple(row))
+                    out_ts.append(m.end_ts)
+            else:
+                out_rows.append(tuple(self.select_fn(m)))
+                out_ts.append(m.end_ts)
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        return {"keyed": {"backend": {"cep": {
+            kg: {k: {"buffer": list(st["buffer"]),
+                     "partials": list(st["partials"])}
+                 for k, st in m.items()}
+            for kg, m in self._state.items()}}},
+            "operator": {"seq": next(self._seq)}}
+
+    def initialize_state(self, keyed_snapshots: list,
+                         operator_snapshot) -> None:
+        for snap in keyed_snapshots:
+            for kg, entries in snap.get("backend", {}).get("cep", {}).items():
+                if kg in self.ctx.key_group_range:
+                    tgt = self._state.setdefault(kg, {})
+                    for k, st in entries.items():
+                        cur = tgt.setdefault(k,
+                                             {"buffer": [], "partials": []})
+                        cur["buffer"].extend(st["buffer"])
+                        cur["partials"].extend(st["partials"])
+        if operator_snapshot and "seq" in operator_snapshot:
+            self._seq = itertools.count(operator_snapshot["seq"])
+

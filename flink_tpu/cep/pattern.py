@@ -1,0 +1,162 @@
+"""CEP Pattern API.
+
+Analog of the reference's fluent pattern DSL (flink-cep
+pattern/Pattern.java: begin:137, where:164, or:184, until:228, within:254,
+next:283, notNext:294, followedBy:312, notFollowedBy:325, followedByAny:343,
+optional:353, oneOrMore:371, times:418, greedy:404, consecutive:559,
+allowCombinations:519; Quantifier.java). Conditions are predicates over the
+event as a dict ``{column: value}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = ["Pattern", "MalformedPatternError"]
+
+# contiguity between stages / inside loops (reference Quantifier
+# ConsumingStrategy: STRICT, SKIP_TILL_NEXT, SKIP_TILL_ANY)
+STRICT = "strict"
+RELAXED = "relaxed"          # followedBy / skip till next
+NDR = "ndr"                  # followedByAny / skip till any
+
+Predicate = Callable[[dict], bool]
+
+
+class MalformedPatternError(ValueError):
+    pass
+
+
+@dataclass
+class Stage:
+    """One compiled pattern node."""
+
+    name: str
+    contiguity: str = RELAXED        # vs the previous stage
+    preds: list = field(default_factory=list)       # OR-combined
+    until: Optional[Predicate] = None
+    min_count: int = 1
+    max_count: Optional[int] = 1     # None = unbounded
+    optional: bool = False
+    negated: bool = False            # notNext / notFollowedBy
+    greedy: bool = False
+    inner_contiguity: str = RELAXED  # within a loop (consecutive -> strict)
+
+    def matches(self, event: dict) -> bool:
+        if not self.preds:
+            return True
+        return any(p(event) for p in self.preds)
+
+    @property
+    def looping(self) -> bool:
+        return self.max_count is None or self.max_count > 1
+
+
+class Pattern:
+    """Fluent builder over a list of stages; terminal ops live on
+    PatternStream (cep/__init__.py)."""
+
+    def __init__(self, stages: list, within_ms: Optional[int] = None):
+        self._stages = stages
+        self.within_ms = within_ms
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def begin(name: str) -> "Pattern":
+        return Pattern([Stage(name, contiguity=RELAXED)])
+
+    def _last(self) -> Stage:
+        return self._stages[-1]
+
+    def _append(self, name: str, contiguity: str,
+                negated: bool = False) -> "Pattern":
+        if any(s.name == name for s in self._stages):
+            raise MalformedPatternError(f"duplicate pattern name {name!r}")
+        self._stages.append(Stage(name, contiguity=contiguity,
+                                  negated=negated))
+        return self
+
+    def next(self, name: str) -> "Pattern":
+        return self._append(name, STRICT)
+
+    def followed_by(self, name: str) -> "Pattern":
+        return self._append(name, RELAXED)
+
+    def followed_by_any(self, name: str) -> "Pattern":
+        return self._append(name, NDR)
+
+    def not_next(self, name: str) -> "Pattern":
+        return self._append(name, STRICT, negated=True)
+
+    def not_followed_by(self, name: str) -> "Pattern":
+        return self._append(name, RELAXED, negated=True)
+
+    # -- conditions --------------------------------------------------------
+    def where(self, pred: Predicate) -> "Pattern":
+        self._last().preds.append(pred)
+        return self
+
+    def or_(self, pred: Predicate) -> "Pattern":
+        return self.where(pred)
+
+    def until(self, pred: Predicate) -> "Pattern":
+        if not self._last().looping:
+            raise MalformedPatternError("until() needs a looping stage")
+        self._last().until = pred
+        return self
+
+    # -- quantifiers -------------------------------------------------------
+    def times(self, n: int, to: Optional[int] = None) -> "Pattern":
+        s = self._last()
+        s.min_count = n
+        s.max_count = n if to is None else to
+        return self
+
+    def times_or_more(self, n: int) -> "Pattern":
+        s = self._last()
+        s.min_count = n
+        s.max_count = None
+        return self
+
+    def one_or_more(self) -> "Pattern":
+        return self.times_or_more(1)
+
+    def optional(self) -> "Pattern":
+        self._last().optional = True
+        return self
+
+    def greedy(self) -> "Pattern":
+        self._last().greedy = True
+        return self
+
+    def consecutive(self) -> "Pattern":
+        """Strict contiguity inside a loop (reference consecutive())."""
+        self._last().inner_contiguity = STRICT
+        return self
+
+    def allow_combinations(self) -> "Pattern":
+        self._last().inner_contiguity = NDR
+        return self
+
+    def within(self, ms: int) -> "Pattern":
+        self.within_ms = int(ms)
+        return self
+
+    # -- compile -----------------------------------------------------------
+    def compile(self) -> list:
+        """Validate and return the stage list for the NFA."""
+        if not self._stages:
+            raise MalformedPatternError("empty pattern")
+        if self._stages[0].negated:
+            raise MalformedPatternError("pattern cannot start with NOT")
+        if self._stages[-1].negated and self.within_ms is None:
+            raise MalformedPatternError(
+                "notFollowedBy cannot be the last pattern without within()")
+        for s in self._stages:
+            if s.negated and (s.looping or s.optional):
+                raise MalformedPatternError(
+                    "NOT patterns cannot be looping or optional")
+        if all(s.negated or s.optional for s in self._stages):
+            raise MalformedPatternError("pattern needs a positive stage")
+        return list(self._stages)

@@ -19,9 +19,16 @@ from typing import Any, Callable, Iterator, Mapping, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Schema", "FieldType", "RecordBatch", "MIN_TIMESTAMP", "MAX_TIMESTAMP"]
+__all__ = ["Schema", "FieldType", "RecordBatch", "MIN_TIMESTAMP",
+           "MAX_TIMESTAMP", "scalar"]
 
 MIN_TIMESTAMP = -(1 << 62)
+
+
+def scalar(v):
+    """numpy scalar -> python scalar (identity otherwise); the canonical
+    row-value unwrapper for host-side operators."""
+    return v.item() if isinstance(v, np.generic) else v
 MAX_TIMESTAMP = (1 << 62) - 1
 
 # Canonical dtype aliases accepted in schemas.

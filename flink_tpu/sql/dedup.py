@@ -15,15 +15,12 @@ from typing import Any, Optional
 import numpy as np
 
 from ..core.keygroups import assign_to_key_group
-from ..core.records import RecordBatch, Schema
+from ..core.records import RecordBatch, Schema, scalar as _scalar
 from ..runtime.operators.base import OneInputOperator
 from . import rowkind as rk
 
 __all__ = ["DeduplicateOperator"]
 
-
-def _scalar(v):
-    return v.item() if isinstance(v, np.generic) else v
 
 
 class DeduplicateOperator(OneInputOperator):

@@ -31,7 +31,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from ..core.keygroups import assign_to_key_group
-from ..core.records import RecordBatch, Schema
+from ..core.records import RecordBatch, Schema, scalar as _scalar
 from ..runtime.operators.base import OneInputOperator, OperatorContext, Output
 from . import rowkind as rk
 
@@ -231,9 +231,6 @@ class GroupAggOperator(OneInputOperator):
                 if kg in self.ctx.key_group_range:
                     self._state.setdefault(kg, {}).update(entries)
 
-
-def _scalar(v):
-    return v.item() if isinstance(v, np.generic) else v
 
 
 def _unique_inverse(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -25,7 +25,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from ..core.keygroups import assign_to_key_group
-from ..core.records import RecordBatch, Schema
+from ..core.records import RecordBatch, Schema, scalar as _scalar
 from ..runtime.operators.base import (
     OneInputOperator, Output, TwoInputOperator,
 )
@@ -34,9 +34,6 @@ from . import rowkind as rk
 __all__ = ["StreamingJoinOperator", "IntervalJoinOperator",
            "LookupJoinOperator"]
 
-
-def _scalar(v):
-    return v.item() if isinstance(v, np.generic) else v
 
 
 def _key_of(row: tuple, kidx) -> Any:

@@ -65,7 +65,8 @@ class OverAggOperator(OneInputOperator):
         keys = batch.column(self.key_column)
         ts = batch.timestamps
         # stable sort by (key-run, ts): group rows per key, keep time order
-        uniq, inverse = np.unique(keys, return_inverse=True)
+        from .group_agg import _unique_inverse
+        uniq, inverse = _unique_inverse(keys)
         order = np.lexsort((ts, inverse))
         n = batch.n
         agg_out = np.zeros((n, len(self.aggs)), np.float64)
@@ -80,18 +81,19 @@ class OverAggOperator(OneInputOperator):
             key = uniq[gi]
             key = key.item() if isinstance(key, np.generic) else key
             kg = assign_to_key_group(key, self.ctx.max_parallelism)
-            acc = self._state.setdefault(kg, {}).get(key)
-            if acc is None:
-                acc = self._init_acc()
             idx = order[starts[gi]:ends[gi]]
             m = len(idx)
             if self.rows_window is None:
+                acc = self._state.setdefault(kg, {}).get(key)
+                if acc is None:
+                    acc = self._init_acc()
                 self._unbounded_run(acc, idx, m, agg_cols, agg_out)
+                self._state[kg][key] = acc
             else:
+                # ROWS windows only need the trailing values, no accumulator
                 tail = self._tails.setdefault(kg, {}).setdefault(
                     key, [[] for _ in self.aggs])
                 self._rows_run(tail, idx, m, agg_cols, agg_out)
-            self._state[kg][key] = acc
         out_cols = {f.name: batch.column(f.name)
                     for f in batch.schema.fields}
         for j, a in enumerate(self.aggs):

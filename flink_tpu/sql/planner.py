@@ -108,6 +108,10 @@ class _Planner:
         if ltvf is not None or rtvf is not None:
             raise PlanError("window TVFs cannot be direct join inputs; wrap "
                             "the windowed aggregation in a subquery")
+        dup = set(lq) & set(rq)
+        if dup:
+            raise PlanError(
+                f"duplicate table alias(es) in join: {sorted(dup)}")
         join_type = {"INNER": "inner", "LEFT": "left", "RIGHT": "right",
                      "FULL": "full"}[jc.kind]
 

@@ -15,7 +15,8 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
-from ..core.records import MIN_TIMESTAMP, RecordBatch, Schema
+from ..core.records import MIN_TIMESTAMP, RecordBatch, Schema, \
+    scalar as _scalar
 from ..runtime.operators.base import OneInputOperator
 from . import rowkind as rk
 
@@ -100,6 +101,3 @@ class TopNOperator(OneInputOperator):
             self._rows = dict(operator_snapshot["rows"])
             self._emitted = list(operator_snapshot["emitted"])
 
-
-def _scalar(v):
-    return v.item() if isinstance(v, np.generic) else v

@@ -1,0 +1,227 @@
+"""CEP tests: pattern API, NFA branching semantics, CepOperator via harness
+and end-to-end (reference test models: flink-cep NFAITCase, CEPITCase)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cep import (
+    CEP, MalformedPatternError, NFA, Pattern, SKIP_PAST_LAST_EVENT,
+)
+from flink_tpu.cep.operator import CepOperator
+from flink_tpu.core.records import Schema
+from flink_tpu.core.watermarks import WatermarkStrategy
+from flink_tpu.runtime.harness import OneInputOperatorTestHarness
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def harness(pattern, select=None, out_schema=None, skip="no_skip"):
+    nfa = NFA(pattern.compile(), pattern.within_ms, skip)
+    out_schema = out_schema or Schema([("k", np.int64),
+                                       ("a", np.int64), ("b", np.int64)])
+    select = select or (lambda m: (m["a"][0]["k"], m["a"][0]["v"],
+                                   m["b"][0]["v"]))
+    op = CepOperator(nfa, "k", select, out_schema)
+    return OneInputOperatorTestHarness(op, schema=SCHEMA)
+
+
+def test_malformed_patterns():
+    with pytest.raises(MalformedPatternError):
+        Pattern.begin("a").followed_by("a")  # duplicate name
+    with pytest.raises(MalformedPatternError):
+        Pattern.begin("a").not_followed_by("end").compile()  # NOT last
+    with pytest.raises(MalformedPatternError):
+        Pattern.begin("a").until(lambda e: True)  # until on non-loop
+
+
+def test_simple_followed_by():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("b").where(lambda e: e["v"] == 3))
+    h = harness(pat)
+    # noise between a and b is skipped (relaxed contiguity)
+    h.process_elements([(7, 1), (7, 2), (7, 3)], [10, 20, 30])
+    h.process_watermark(100)
+    assert h.get_output() == [(7, 1, 3)]
+
+
+def test_next_strict_contiguity():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .next("b").where(lambda e: e["v"] == 3))
+    h = harness(pat)
+    h.process_elements([(7, 1), (7, 2), (7, 3), (7, 1), (7, 3)],
+                       [10, 20, 30, 40, 50])
+    h.process_watermark(100)
+    # only the adjacent 1,3 at ts 40,50 matches
+    assert h.get_output() == [(7, 1, 3)]
+
+
+def test_followed_by_any_branches():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by_any("b").where(lambda e: e["v"] >= 2))
+    h = harness(pat)
+    h.process_elements([(7, 1), (7, 2), (7, 3)], [10, 20, 30])
+    h.process_watermark(100)
+    # ANY: the a@10 matches BOTH b@20 and b@30
+    assert sorted(h.get_output()) == [(7, 1, 2), (7, 1, 3)]
+
+
+def test_one_or_more_emits_growing_matches():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1).one_or_more()
+           .followed_by("b").where(lambda e: e["v"] == 9))
+    h = harness(pat, select=lambda m: (m["a"][0]["k"], len(m["a"]),
+                                       m["b"][0]["v"]),
+                out_schema=Schema([("k", np.int64), ("n_a", np.int64),
+                                   ("b", np.int64)]))
+    h.process_elements([(7, 1), (7, 1), (7, 9)], [10, 20, 30])
+    h.process_watermark(100)
+    # both [a@10,a@20] and [a@20] (and [a@10]) complete with b@30
+    ns = sorted(r[1] for r in h.get_output())
+    assert 2 in ns and 1 in ns
+
+
+def test_times_exact():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1).times(3)
+           .consecutive()
+           .followed_by("b").where(lambda e: e["v"] == 9))
+    h = harness(pat, select=lambda m: (m["a"][0]["k"], len(m["a"]),
+                                       m["b"][0]["v"]),
+                out_schema=Schema([("k", np.int64), ("n_a", np.int64),
+                                   ("b", np.int64)]))
+    h.process_elements([(1, 1), (1, 1), (1, 1), (1, 9)], [1, 2, 3, 4])
+    h.process_watermark(100)
+    out = h.get_output()
+    assert (1, 3, 9) in out
+
+
+def test_within_window_prunes():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("b").where(lambda e: e["v"] == 2)
+           .within(100))
+    h = harness(pat)
+    h.process_elements([(7, 1)], [10])
+    h.process_elements([(7, 2)], [500])   # too late: 500-10 > 100
+    h.process_watermark(1000)
+    assert h.get_output() == []
+    # within the window it matches
+    h.process_elements([(7, 1), (7, 2)], [1100, 1150])
+    h.process_watermark(2000)
+    assert h.get_output() == [(7, 1, 2)]
+
+
+def test_not_followed_by_blocks():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .not_followed_by("bad").where(lambda e: e["v"] == 5)
+           .followed_by("b").where(lambda e: e["v"] == 2))
+    h = harness(pat)
+    h.process_elements([(7, 1), (7, 5), (7, 2)], [10, 20, 30])
+    h.process_watermark(100)
+    assert h.get_output() == []          # 5 between 1 and 2 kills it
+    h.process_elements([(8, 1), (8, 3), (8, 2)], [110, 120, 130])
+    h.process_watermark(200)
+    assert h.get_output() == [(8, 1, 2)]  # harmless noise doesn't
+
+
+def test_not_next_only_blocks_adjacent():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .not_next("bad").where(lambda e: e["v"] == 5)
+           .followed_by("b").where(lambda e: e["v"] == 2))
+    h = harness(pat)
+    # 5 NOT adjacent to 1 -> ok
+    h.process_elements([(7, 1), (7, 3), (7, 5), (7, 2)], [10, 20, 30, 40])
+    h.process_watermark(100)
+    assert h.get_output() == [(7, 1, 2)]
+    # 5 adjacent to 1 -> blocked
+    h.clear_output()
+    h.process_elements([(8, 1), (8, 5), (8, 2)], [110, 120, 130])
+    h.process_watermark(200)
+    assert h.get_output() == []
+
+
+def test_trailing_not_with_within_fires_on_timeout():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .not_followed_by("bad").where(lambda e: e["v"] == 5)
+           .within(100))
+    h = harness(pat, select=lambda m: (m["a"][0]["k"], m["a"][0]["v"]),
+                out_schema=Schema([("k", np.int64), ("a", np.int64)]))
+    h.process_elements([(7, 1)], [10])
+    h.process_watermark(500)             # window passed, no 5 seen
+    assert h.get_output() == [(7, 1)]
+    h.clear_output()
+    h.process_elements([(8, 1), (8, 5)], [600, 650])  # 5 within window
+    h.process_watermark(1200)
+    assert h.get_output() == []
+
+
+def test_optional_stage():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("mid").where(lambda e: e["v"] == 2).optional()
+           .followed_by("b").where(lambda e: e["v"] == 3))
+    h = harness(pat, select=lambda m: (m["a"][0]["k"], len(m.events),
+                                       m["b"][0]["v"]),
+                out_schema=Schema([("k", np.int64), ("n", np.int64),
+                                   ("b", np.int64)]))
+    h.process_elements([(7, 1), (7, 3)], [10, 20])   # skip optional
+    h.process_watermark(100)
+    assert (7, 2, 3) in h.get_output()
+    h.clear_output()
+    h.process_elements([(8, 1), (8, 2), (8, 3)], [110, 120, 130])
+    h.process_watermark(200)
+    assert (8, 3, 3) in h.get_output()   # with optional stage captured
+
+
+def test_skip_past_last_event():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("b").where(lambda e: e["v"] == 2))
+    h = harness(pat, skip=SKIP_PAST_LAST_EVENT)
+    h.process_elements([(7, 1), (7, 1), (7, 2)], [10, 20, 30])
+    h.process_watermark(100)
+    assert len(h.get_output()) == 1      # second overlapping match skipped
+
+
+def test_keys_are_independent():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("b").where(lambda e: e["v"] == 2))
+    h = harness(pat)
+    h.process_elements([(1, 1), (2, 2), (2, 1), (1, 2)], [10, 20, 30, 40])
+    h.process_watermark(100)
+    assert sorted(h.get_output()) == [(1, 1, 2)]  # cross-key 1->2 not matched
+    h.process_elements([(2, 2)], [150])
+    h.process_watermark(200)
+    assert sorted(h.get_output()) == [(1, 1, 2), (2, 1, 2)]
+
+
+def test_cep_snapshot_restore():
+    pat = (Pattern.begin("a").where(lambda e: e["v"] == 1)
+           .followed_by("b").where(lambda e: e["v"] == 2))
+    h = harness(pat)
+    h.process_elements([(7, 1)], [10])
+    h.process_watermark(15)              # a consumed into a partial
+    snap = h.snapshot()
+
+    nfa = NFA(pat.compile(), pat.within_ms)
+    out_schema = Schema([("k", np.int64), ("a", np.int64), ("b", np.int64)])
+    h2 = OneInputOperatorTestHarness.restored(
+        lambda: CepOperator(nfa, "k",
+                            lambda m: (m["a"][0]["k"], m["a"][0]["v"],
+                                       m["b"][0]["v"]), out_schema),
+        snap, schema=SCHEMA)
+    h2.process_elements([(7, 2)], [20])
+    h2.process_watermark(100)
+    assert h2.get_output() == [(7, 1, 2)]
+
+
+def test_cep_end_to_end():
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    rows = [(1, 1), (1, 4), (1, 2), (2, 1), (2, 9)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=[10, 20, 30, 40, 50])
+    pat = (Pattern.begin("start").where(lambda e: e["v"] == 1)
+           .followed_by("end").where(lambda e: e["v"] == 2))
+    out_schema = Schema([("k", np.int64), ("sv", np.int64),
+                         ("ev", np.int64)])
+    out = CEP.pattern(ds, pat, key="k").select(
+        lambda m: (m["start"][0]["k"], m["start"][0]["v"],
+                   m["end"][0]["v"]), out_schema)
+    rows_out = out.execute_and_collect("cep")
+    assert rows_out == [(1, 1, 2)]

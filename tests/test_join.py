@@ -92,8 +92,14 @@ def test_left_outer_join_null_padding_and_revision():
     assert out[1] == (5, 50.0, 5.0, 500.0, int(rk.INSERT))
     # right retraction restores the null padding
     h.clear_output()
-    h.process_element2({"rk_": 5, "rv": 500,
-                        rk.ROWKIND_COLUMN: int(rk.DELETE)}, 2)
+    h.schemas[1] = Schema([("rk_", np.int64), ("rv", np.int64),
+                           (rk.ROWKIND_COLUMN, np.int8)])
+    h.process_element2((5, 500, int(rk.DELETE)), 2)
+    out = h.get_output()
+    kinds = [r[-1] for r in out]
+    assert kinds == [int(rk.DELETE), int(rk.INSERT)]
+    assert out[0] == (5, 50.0, 5.0, 500.0, int(rk.DELETE))
+    assert out[1][0] == 5 and np.isnan(out[1][2])
 
 
 def test_right_row_retraction():
