@@ -1,0 +1,18 @@
+"""Connectors: split-based sources and two-phase sinks (reference
+flink-connectors + api/connector SPI). See core.py (SPI + collection/datagen),
+file.py (FileSource/FileSink), socket.py, log.py (Kafka-shaped)."""
+
+from .core import (
+    CollectionSource, CollectSink, DataGenSource, PrintSink, Sink,
+    SinkWriter, Source, SourceReader, SourceSplit,
+)
+from .file import FileSink, FileSource
+from .log import InMemoryLogBroker, LogBroker, LogSink, LogSource
+from .socket import SocketSource
+
+__all__ = [
+    "Source", "SourceReader", "SourceSplit", "Sink", "SinkWriter",
+    "CollectionSource", "DataGenSource", "CollectSink", "PrintSink",
+    "FileSource", "FileSink", "SocketSource",
+    "LogBroker", "InMemoryLogBroker", "LogSource", "LogSink",
+]

@@ -74,6 +74,16 @@ class SinkWriter:
     def flush(self) -> None:
         """Pre-commit flush at checkpoint barriers (two-phase phase 1)."""
 
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        """Stage everything written so far as a committable for this
+        checkpoint (reference TwoPhaseCommittingSink.PrecommittingSinkWriter
+        .prepareCommit); called after flush() during the snapshot."""
+
+    def commit(self, checkpoint_id: int) -> None:
+        """Make committables up to ``checkpoint_id`` durable/visible
+        (reference Committer.commit); called on checkpoint-complete
+        notification. Must be idempotent — redelivery happens on recovery."""
+
     def snapshot(self) -> Any:
         return None
 

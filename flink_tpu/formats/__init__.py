@@ -1,0 +1,6 @@
+"""Formats: CSV / JSON / native binary batch codecs (reference
+flink-formats). See formats/core.py."""
+
+from .core import BinaryFormat, CsvFormat, Format, JsonFormat
+
+__all__ = ["Format", "CsvFormat", "JsonFormat", "BinaryFormat"]

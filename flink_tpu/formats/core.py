@@ -1,0 +1,221 @@
+"""Record formats: text/binary encodings at source/sink boundaries.
+
+Analog of the reference's flink-formats family (csv/json DeserializationSchema
+and SerializationSchema implementations, e.g. flink-formats/flink-csv
+CsvRowDataDeserializationSchema, flink-json JsonRowDataDeserializationSchema)
+collapsed to a batch-oriented SPI: a Format decodes a block of lines/bytes
+into one columnar RecordBatch (not one object per record) and encodes a batch
+back, so the hot path stays vectorized end to end.
+
+``BinaryFormat`` is the framework-native block format (the avro/parquet slot):
+it reuses the versioned batch codec (core/serializers.serialize_batch) with a
+length-prefixed framing, self-describing and schema-checked on read.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..core.records import RecordBatch, Schema
+from ..core.serializers import deserialize_batch, serialize_batch
+
+__all__ = ["Format", "CsvFormat", "JsonFormat", "BinaryFormat"]
+
+
+class Format:
+    """Bidirectional text/binary <-> RecordBatch codec."""
+
+    schema: Schema
+    binary: bool = False
+
+    def decode_lines(self, lines: list[str]) -> RecordBatch:
+        raise NotImplementedError
+
+    def encode_batch(self, batch: RecordBatch) -> str:
+        """Batch -> text block (newline-terminated)."""
+        raise NotImplementedError
+
+    # binary formats implement these instead
+    def decode_block(self, data: bytes) -> tuple[list[RecordBatch], bytes]:
+        """Consume whole frames from ``data``; return (batches, remainder)."""
+        raise NotImplementedError
+
+    def encode_block(self, batch: RecordBatch) -> bytes:
+        raise NotImplementedError
+
+
+def _unescape_nl(s: str) -> str:
+    """Reverse CsvFormat's backslash escaping of newlines."""
+    if "\\" not in s:
+        return s
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            if s[i + 1] == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if s[i + 1] == "\\":
+                out.append("\\")
+                i += 2
+                continue
+        out.append(s[i])
+        i += 1
+    return "".join(out)
+
+
+def _parse_column(vals: list[str], dtype) -> np.ndarray:
+    if dtype is object:
+        return np.array([v if v != "" else None for v in vals], dtype=object)
+    if np.issubdtype(np.dtype(dtype), np.bool_):
+        return np.array([v.lower() in ("true", "1") for v in vals],
+                        dtype=np.bool_)
+    # numeric: empty -> NaN (then cast); int columns reject empties loudly
+    arr = np.array([v if v != "" else "nan" for v in vals], dtype=object)
+    return arr.astype(np.float64).astype(dtype)
+
+
+class CsvFormat(Format):
+    """Delimiter-separated text (reference flink-csv). Quoting: fields
+    containing the delimiter or quotes are double-quoted on write and
+    unquoted on read; embedded quotes escape by doubling. Embedded newlines
+    are backslash-escaped (``\\n``) instead of quoted-literal, keeping every
+    consumer line-based (a deliberate divergence from RFC 4180, documented
+    here). ``skip_header`` is consumed by file readers per file start —
+    decode_lines itself is stateless (pass at_file_start=True to skip)."""
+
+    def __init__(self, schema: Schema, delimiter: str = ",",
+                 skip_header: bool = False):
+        self.schema = schema
+        self.delimiter = delimiter
+        self.skip_header = skip_header
+
+    def _split(self, line: str) -> list[str]:
+        d = self.delimiter
+        if '"' not in line:
+            return [_unescape_nl(s) for s in line.split(d)]
+        out, cur, in_q, i = [], [], False, 0
+        while i < len(line):
+            c = line[i]
+            if in_q:
+                if c == '"':
+                    if i + 1 < len(line) and line[i + 1] == '"':
+                        cur.append('"')
+                        i += 1
+                    else:
+                        in_q = False
+                else:
+                    cur.append(c)
+            elif c == '"':
+                in_q = True
+            elif c == d:
+                out.append("".join(cur))
+                cur = []
+            else:
+                cur.append(c)
+            i += 1
+        out.append("".join(cur))
+        return [_unescape_nl(s) for s in out]
+
+    def decode_lines(self, lines: list[str],
+                     at_file_start: bool = False) -> RecordBatch:
+        if self.skip_header and at_file_start and lines:
+            lines = lines[1:]
+        rows = [self._split(ln) for ln in lines if ln]
+        if not rows:
+            return RecordBatch.empty(self.schema)
+        n_fields = len(self.schema)
+        cols = {}
+        for j, f in enumerate(self.schema.fields):
+            vals = [r[j] if j < len(r) else "" for r in rows]
+            cols[f.name] = _parse_column(vals, f.dtype)
+        return RecordBatch(self.schema, cols)
+
+    def encode_batch(self, batch: RecordBatch) -> str:
+        d = self.delimiter
+        out = []
+        for row in batch.iter_rows():
+            if not isinstance(row, tuple):
+                row = (row,)
+            fields = []
+            for v in row:
+                s = "" if v is None else str(v)
+                if "\\" in s or "\n" in s:
+                    s = s.replace("\\", "\\\\").replace("\n", "\\n")
+                if d in s or '"' in s:
+                    s = '"' + s.replace('"', '""') + '"'
+                fields.append(s)
+            out.append(d.join(fields))
+        return "\n".join(out) + ("\n" if out else "")
+
+
+class JsonFormat(Format):
+    """Newline-delimited JSON objects (reference flink-json)."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+    def decode_lines(self, lines: list[str]) -> RecordBatch:
+        objs = [json.loads(ln) for ln in lines if ln.strip()]
+        if not objs:
+            return RecordBatch.empty(self.schema)
+        cols = {}
+        for f in self.schema.fields:
+            vals = [o.get(f.name) for o in objs]
+            if f.dtype is object:
+                cols[f.name] = np.array(vals, dtype=object)
+            else:
+                cols[f.name] = np.array(
+                    [v if v is not None else np.nan for v in vals]
+                ).astype(f.dtype)
+        return RecordBatch(self.schema, cols)
+
+    def encode_batch(self, batch: RecordBatch) -> str:
+        names = batch.schema.names
+        out = []
+        for row in batch.iter_rows():
+            if not isinstance(row, tuple):
+                row = (row,)
+            out.append(json.dumps(dict(zip(names, row)), default=_json_default))
+        return "\n".join(out) + ("\n" if out else "")
+
+
+def _json_default(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, float) and np.isnan(v):
+        return None
+    raise TypeError(f"not JSON serializable: {type(v)}")
+
+
+_FRAME = struct.Struct("<I")  # frame length prefix
+
+
+class BinaryFormat(Format):
+    """Length-prefixed framed batches over the native batch codec — the
+    self-describing binary slot (what avro/parquet fill in the reference)."""
+
+    binary = True
+
+    def __init__(self, schema: Optional[Schema] = None):
+        self.schema = schema
+
+    def encode_block(self, batch: RecordBatch) -> bytes:
+        payload = serialize_batch(batch)
+        return _FRAME.pack(len(payload)) + payload
+
+    def decode_block(self, data: bytes) -> tuple[list[RecordBatch], bytes]:
+        batches = []
+        while len(data) >= _FRAME.size:
+            (ln,) = _FRAME.unpack_from(data)
+            if len(data) < _FRAME.size + ln:
+                break
+            payload = data[_FRAME.size:_FRAME.size + ln]
+            batches.append(deserialize_batch(payload))
+            data = data[_FRAME.size + ln:]
+        return batches, data

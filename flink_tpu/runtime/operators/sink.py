@@ -35,10 +35,18 @@ class SinkOperator(OneInputOperator):
 
     def snapshot_state(self, checkpoint_id: int) -> dict:
         self._writer.flush()
+        self._writer.prepare_commit(checkpoint_id)
         return {"operator": self._writer.snapshot()}
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        self._writer.commit(checkpoint_id)
 
     def finish(self) -> None:
         self._writer.flush()
+        # end of input: stage and commit everything outstanding (reference
+        # StreamingFileSink closes in-progress files on final checkpoint)
+        self._writer.prepare_commit(1 << 62)
+        self._writer.commit(1 << 62)
 
     def close(self) -> None:
         self._writer.close()

@@ -1,0 +1,333 @@
+"""Formats + file/socket/log connectors (reference test models:
+flink-formats unit tests, FileSinkITCase, KafkaSourceITCase shapes)."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.connectors import (
+    FileSink, FileSource, InMemoryLogBroker, LogSink, LogSource,
+    SocketSource,
+)
+from flink_tpu.connectors.file import _FileWriter
+from flink_tpu.core.records import RecordBatch, Schema
+from flink_tpu.formats import BinaryFormat, CsvFormat, JsonFormat
+
+SCHEMA = Schema([("k", np.int64), ("v", np.float64), ("name", object)])
+
+
+def make_batch(rows, ts=None):
+    return RecordBatch.from_rows(SCHEMA, rows,
+                                 ts or list(range(len(rows))))
+
+
+# -- formats ---------------------------------------------------------------
+
+def test_csv_roundtrip():
+    fmt = CsvFormat(SCHEMA)
+    batch = make_batch([(1, 1.5, "a"), (2, 2.5, "with,comma"),
+                        (3, 3.5, 'with"quote')])
+    text = fmt.encode_batch(batch)
+    back = fmt.decode_lines(text.strip().split("\n"))
+    assert back.n == 3
+    assert list(back.column("k")) == [1, 2, 3]
+    assert back.column("name")[1] == "with,comma"
+    assert back.column("name")[2] == 'with"quote'
+
+
+def test_csv_nulls_and_header():
+    fmt = CsvFormat(SCHEMA, skip_header=True)
+    rows = fmt.decode_lines(["k,v,name", "1,2.0,", "2,,x"],
+                            at_file_start=True)
+    assert rows.n == 2
+    assert rows.column("name")[0] is None
+    assert np.isnan(rows.column("v")[1])
+
+
+def test_csv_header_skipped_per_file(tmp_path):
+    """Every file's header is skipped, not just the first (regression:
+    header state used to live on the shared Format instance)."""
+    fmt = CsvFormat(SCHEMA, skip_header=True)
+    for i in range(2):
+        (tmp_path / f"f{i}.csv").write_text(f"k,v,name\n{i},1.0,x\n")
+    env = StreamExecutionEnvironment()
+    out = env.from_source(FileSource(str(tmp_path), fmt),
+                          name="f").execute_and_collect("hdr")
+    assert sorted(r[0] for r in out) == [0, 1]
+
+
+def test_csv_embedded_newline_roundtrip():
+    fmt = CsvFormat(SCHEMA)
+    batch = make_batch([(1, 1.0, "line1\nline2"), (2, 2.0, "back\\slash")])
+    text = fmt.encode_batch(batch)
+    assert text.count("\n") == 2  # stays line-based
+    back = fmt.decode_lines(text.strip().split("\n"))
+    assert back.column("name")[0] == "line1\nline2"
+    assert back.column("name")[1] == "back\\slash"
+
+
+def test_json_roundtrip():
+    fmt = JsonFormat(SCHEMA)
+    batch = make_batch([(1, 1.5, "a"), (2, 2.5, None)])
+    text = fmt.encode_batch(batch)
+    back = fmt.decode_lines(text.strip().split("\n"))
+    assert back.n == 2
+    assert back.column("name")[1] is None
+    assert back.column("v")[0] == 1.5
+
+
+def test_binary_roundtrip_partial_frames():
+    fmt = BinaryFormat(SCHEMA)
+    b1 = make_batch([(1, 1.0, "x")])
+    b2 = make_batch([(2, 2.0, "y"), (3, 3.0, "z")])
+    data = fmt.encode_block(b1) + fmt.encode_block(b2)
+    # split mid-frame: second frame incomplete
+    cut = len(fmt.encode_block(b1)) + 3
+    batches, rest = fmt.decode_block(data[:cut])
+    assert len(batches) == 1 and batches[0].n == 1
+    batches2, rest2 = fmt.decode_block(rest + data[cut:])
+    assert len(batches2) == 1 and batches2[0].n == 2
+    assert rest2 == b""
+
+
+# -- file source/sink ------------------------------------------------------
+
+def test_file_source_csv(tmp_path):
+    fmt = CsvFormat(SCHEMA)
+    for i in range(3):
+        (tmp_path / f"data-{i}.csv").write_text(
+            f"{i},{i}.5,row{i}\n{i + 10},{i}.25,row{i}b\n")
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    src = FileSource(str(tmp_path), fmt)
+    out = env.from_source(src, name="files").execute_and_collect("read")
+    assert len(out) == 6
+    assert sorted(r[0] for r in out) == [0, 1, 2, 10, 11, 12]
+
+
+def test_file_reader_offset_resume(tmp_path):
+    fmt = CsvFormat(SCHEMA)
+    p = tmp_path / "a.csv"
+    p.write_text("".join(f"{i},1.0,x\n" for i in range(100)))
+    src = FileSource(str(p), fmt, batch_lines=10)
+    [split] = src.create_splits(1)
+    r = src.create_reader(split)
+    b1 = r.read_batch(10)
+    state = r.snapshot()
+    # new reader restored mid-file continues exactly
+    r2 = src.create_reader(split)
+    r2.restore(state)
+    b2 = r2.read_batch(10)
+    assert list(b2.column("k")) == list(range(10, 20))
+
+
+def test_file_sink_two_phase_commit(tmp_path):
+    fmt = CsvFormat(SCHEMA)
+    sink = FileSink(str(tmp_path), fmt)
+    w = sink.create_writer(0)
+    w.write_batch(make_batch([(1, 1.0, "a")]))
+    # nothing visible before commit
+    assert [f for f in os.listdir(tmp_path) if not f.startswith(".")] == []
+    w.flush()
+    w.prepare_commit(1)
+    assert [f for f in os.listdir(tmp_path) if not f.startswith(".")] == []
+    w.commit(1)
+    visible = [f for f in os.listdir(tmp_path) if not f.startswith(".")]
+    assert visible == ["part-0-0"]
+    # second epoch
+    w.write_batch(make_batch([(2, 2.0, "b")]))
+    w.prepare_commit(2)
+    w.commit(2)
+    assert len([f for f in os.listdir(tmp_path)
+                if not f.startswith(".")]) == 2
+    w.close()
+
+
+def test_file_sink_stale_cleanup_and_restore(tmp_path):
+    fmt = CsvFormat(SCHEMA)
+    sink = FileSink(str(tmp_path), fmt)
+    w = sink.create_writer(0)
+    w.write_batch(make_batch([(1, 1.0, "a")]))
+    w.prepare_commit(1)
+    snap = w.snapshot()          # checkpoint 1 snapshotted, not committed
+    w.write_batch(make_batch([(2, 2.0, "b")]))  # post-checkpoint writes
+    w.close()
+    # restore from checkpoint 1: pending file commits, stale one is cleaned
+    w2 = sink.create_writer(0)
+    w2.restore(snap)
+    w2.write_batch(make_batch([(3, 3.0, "c")]))
+    w2.prepare_commit(2)
+    w2.commit(2)
+    visible = sorted(f for f in os.listdir(tmp_path)
+                     if not f.startswith("."))
+    content = "".join((tmp_path / f).read_text() for f in visible)
+    assert "1,1.0,a" in content and "3,3.0,c" in content
+    assert "2,2.0,b" not in content      # uncommitted write rolled back
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".inprogress")]
+    assert leftovers == [] or all(".part-0-" not in f for f in leftovers)
+
+
+def test_file_roundtrip_end_to_end(tmp_path):
+    fmt = JsonFormat(SCHEMA)
+    out_dir = tmp_path / "out"
+    env = StreamExecutionEnvironment()
+    rows = [(i, float(i), f"r{i}") for i in range(20)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(20)))
+    ds.sink_to(FileSink(str(out_dir), fmt), "files")
+    env.execute("write")
+    env2 = StreamExecutionEnvironment()
+    back = env2.from_source(FileSource(str(out_dir), fmt),
+                            name="files").execute_and_collect("read")
+    assert sorted(r[0] for r in back) == list(range(20))
+
+
+# -- socket ----------------------------------------------------------------
+
+def test_socket_source():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.sendall(b"hello\nworld\npartial")
+        time.sleep(0.05)
+        conn.sendall(b"-done\n")
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    src = SocketSource("127.0.0.1", port)
+    [split, idle] = src.create_splits(2)
+    r = src.create_reader(split)
+    got = []
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        b = r.read_batch(100)
+        if b is None:
+            break
+        got.extend(b.column("line"))
+    assert got == ["hello", "world", "partial-done"]
+    # idle split yields empty batches, never None
+    ri = src.create_reader(idle)
+    assert ri.read_batch(10).n == 0
+
+
+# -- partitioned log (kafka-shaped) ----------------------------------------
+
+def test_log_source_sink_roundtrip():
+    broker = InMemoryLogBroker(num_partitions=3)
+    broker.create_topic("in")
+    fmt = CsvFormat(SCHEMA)
+    for p in range(3):
+        broker.append("in", p, [f"{p * 10 + i},{i}.0,p{p}" for i in range(5)])
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    src = LogSource(broker, "in", fmt, bounded=True)
+    rows = env.from_source(src, name="log").execute_and_collect("consume")
+    assert len(rows) == 15
+    assert sorted(r[0] for r in rows)[:5] == [0, 1, 2, 3, 4]
+
+
+def test_log_reader_offset_restore():
+    broker = InMemoryLogBroker(num_partitions=1)
+    broker.create_topic("t")
+    fmt = CsvFormat(SCHEMA)
+    broker.append("t", 0, [f"{i},0.0,x" for i in range(10)])
+    src = LogSource(broker, "t", fmt, bounded=True)
+    [split] = src.create_splits(1)
+    r = src.create_reader(split)
+    first = r.read_batch(4)
+    assert list(first.column("k")) == [0, 1, 2, 3]
+    state = r.snapshot()
+    r2 = src.create_reader(split)
+    r2.restore(state)
+    nxt = r2.read_batch(4)
+    assert list(nxt.column("k")) == [4, 5, 6, 7]
+
+
+def test_file_sink_size_roll_not_committed_early():
+    """Size-rolled files created AFTER prepare_commit(cid) must not be
+    committed by notify(cid) (regression: pending[-1] leaked into commit)."""
+    import tempfile
+    d = tempfile.mkdtemp()
+    fmt = CsvFormat(SCHEMA)
+    sink = FileSink(d, fmt, rolling_size=1)  # roll on every batch
+    w = sink.create_writer(0)
+    w.write_batch(make_batch([(1, 1.0, "a")]))
+    w.prepare_commit(1)
+    w.write_batch(make_batch([(2, 2.0, "post-barrier")]))  # rolls to -1 key
+    w.commit(1)
+    visible = "".join(
+        open(os.path.join(d, f)).read() for f in os.listdir(d)
+        if not f.startswith("."))
+    assert "post-barrier" not in visible
+    w.prepare_commit(2)
+    w.commit(2)
+    visible = "".join(
+        open(os.path.join(d, f)).read() for f in os.listdir(d)
+        if not f.startswith("."))
+    assert "post-barrier" in visible
+
+
+def test_log_restore_is_idempotent():
+    """Restoring a snapshot whose epoch already committed must not duplicate
+    records (txn-id dedup)."""
+    broker = InMemoryLogBroker(num_partitions=1)
+    broker.create_topic("t", 1)
+    fmt = CsvFormat(SCHEMA)
+    sink = LogSink(broker, "t", fmt)
+    w = sink.create_writer(0)
+    w.write_batch(make_batch([(1, 1.0, "a")]))
+    w.prepare_commit(1)
+    snap = w.snapshot()
+    w.commit(1)                       # committed before the "crash"
+    assert broker.end_offset("t", 0) == 1
+    w2 = sink.create_writer(0)
+    w2.restore(snap)                  # re-delivery must be a no-op
+    assert broker.end_offset("t", 0) == 1
+
+
+def test_socket_burst_beyond_max_records():
+    """Lines past max_records are kept for the next poll, not dropped."""
+    from flink_tpu.connectors.socket import _SocketReader
+    r = _SocketReader("127.0.0.1", 1, Schema([("line", object)]), 0, 0)
+    r._eof = True
+    r._buf = b"".join(b"l%d\n" % i for i in range(25))
+    b1 = r.read_batch(10)
+    b2 = r.read_batch(10)
+    b3 = r.read_batch(10)
+    got = list(b1.column("line")) + list(b2.column("line")) \
+        + list(b3.column("line"))
+    assert got == [f"l{i}" for i in range(25)]
+    assert r.read_batch(10) is None
+
+
+def test_log_sink_transactional():
+    broker = InMemoryLogBroker(num_partitions=2)
+    broker.create_topic("out", 2)
+    fmt = CsvFormat(SCHEMA)
+    sink = LogSink(broker, "out", fmt, partition_by="k")
+    w = sink.create_writer(0)
+    w.write_batch(make_batch([(1, 1.0, "a"), (2, 2.0, "b")]))
+    # not visible before checkpoint completes
+    assert broker.end_offset("out", 0) + broker.end_offset("out", 1) == 0
+    w.prepare_commit(1)
+    assert broker.end_offset("out", 0) + broker.end_offset("out", 1) == 0
+    w.commit(1)
+    assert broker.end_offset("out", 0) + broker.end_offset("out", 1) == 2
+    # same-key rows land in the same partition
+    w.write_batch(make_batch([(1, 3.0, "c")]))
+    w.prepare_commit(2)
+    w.commit(2)
+    p1 = next(p for p in (0, 1)
+              if any("1," in s for _, s in broker.poll("out", p, 0, 10)))
+    assert sum(1 for _, s in broker.poll("out", p1, 0, 10)
+               if s.startswith("1,")) == 2
