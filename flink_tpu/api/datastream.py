@@ -117,6 +117,37 @@ class DataStream:
                                                                   name=name),
                                parallelism)
 
+    def async_io(self, fn, capacity: int = 100,
+                 timeout_ms: Optional[int] = None, mode: str = "ordered",
+                 retry=None, on_timeout: str = "fail",
+                 out_schema: Optional[Schema] = None,
+                 parallelism: Optional[int] = None,
+                 name: str = "AsyncIO") -> "DataStream":
+        """Asynchronous external lookups (reference AsyncDataStream
+        .orderedWait/unorderedWait -> AsyncWaitOperator). ``fn`` is an
+        AsyncFunction (runtime/operators/async_io.py); each subtask gets
+        its own copy, so open resources (thread pools, clients) in
+        ``open()``, not ``__init__`` — the reference RichFunction
+        pattern."""
+        import copy
+        from ..runtime.operators.async_io import AsyncWaitOperator
+
+        def make_fn():
+            try:
+                return copy.deepcopy(fn)
+            except Exception as e:
+                raise ValueError(
+                    f"AsyncFunction {type(fn).__name__} is not copyable "
+                    f"per subtask ({e!r}); create connections/pools in "
+                    "open() instead of __init__") from e
+
+        return self._one_input(
+            name, lambda: AsyncWaitOperator(
+                make_fn(), capacity=capacity, timeout_ms=timeout_ms,
+                mode=mode, retry=retry, on_timeout=on_timeout,
+                out_schema=out_schema, name=name),
+            parallelism=parallelism)
+
     # -- keying / partitioning --------------------------------------------
     def key_by(self, key: KeySpec) -> "KeyedStream":
         from ..runtime.writer import KeyGroupPartitioner

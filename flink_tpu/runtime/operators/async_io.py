@@ -1,0 +1,272 @@
+"""Async I/O: non-blocking external lookups inside a stream.
+
+Analog of the reference's AsyncWaitOperator + AsyncFunction (flink-streaming
+api/operators/async/AsyncWaitOperator.java:92, AsyncDataStream): each record
+issues an asynchronous request; up to ``capacity`` requests are in flight; a
+full queue backpressures the task (the reference blocks the mailbox the same
+way). Results re-enter the stream either in record order ("ordered") or as
+they complete ("unordered"). Timeouts go through a retry policy, then either
+fail the job or emit nothing ("ignore").
+
+Batch-runtime adaptation: completed futures are drained at every batch /
+watermark / processing-time tick instead of via mailbox mails. Checkpoints
+snapshot the queue of un-resolved input elements (exactly the reference's
+element-queue snapshot, AsyncWaitOperator.snapshotState) and re-submit them
+on restore — results emitted after the barrier are covered by the snapshot,
+so replay after failure reproduces them exactly once.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from ...core.records import RecordBatch, Schema, scalar as _scalar
+from .base import OneInputOperator, OperatorContext, Output
+
+__all__ = ["AsyncFunction", "AsyncWaitOperator", "RetryPolicy"]
+
+
+class AsyncFunction:
+    """User hook (reference AsyncFunction): ``async_invoke`` returns the
+    result rows directly (sync fast path) or a Future resolving to them.
+    Result = one row tuple, an iterable of row tuples, or None (no
+    output)."""
+
+    def open(self) -> None:
+        pass
+
+    def async_invoke(self, row: tuple, timestamp: int):
+        raise NotImplementedError
+
+    def timeout(self, row: tuple):
+        """Result to use when retries are exhausted in 'ignore' mode."""
+        return None
+
+    def close(self) -> None:
+        pass
+
+
+@dataclass
+class RetryPolicy:
+    """Fixed-delay retry (reference AsyncRetryStrategies)."""
+
+    max_attempts: int = 3
+    delay_ms: int = 100
+
+
+@dataclass
+class _Entry:
+    row: tuple
+    ts: int
+    future: Any
+    deadline: Optional[float]     # monotonic seconds
+    attempts: int = 1
+    not_before: float = 0.0       # retry backoff gate (monotonic)
+
+
+class AsyncWaitOperator(OneInputOperator):
+    def __init__(self, fn: AsyncFunction, capacity: int = 100,
+                 timeout_ms: Optional[int] = None, mode: str = "ordered",
+                 retry: Optional[RetryPolicy] = None,
+                 on_timeout: str = "fail",
+                 out_schema: Optional[Schema] = None,
+                 executor: Optional[ThreadPoolExecutor] = None,
+                 name: str = "AsyncWait"):
+        super().__init__(name)
+        if mode not in ("ordered", "unordered"):
+            raise ValueError("mode must be ordered|unordered")
+        if on_timeout not in ("fail", "ignore"):
+            raise ValueError("on_timeout must be fail|ignore")
+        self._fn = fn
+        self._capacity = capacity
+        self._timeout_ms = timeout_ms
+        self._mode = mode
+        self._retry = retry or RetryPolicy(max_attempts=1)
+        self._on_timeout = on_timeout
+        self.out_schema = out_schema
+        self._own_executor = executor is None
+        self._executor = executor
+        self._pending: deque[_Entry] = deque()
+        self._restored_rows: list[tuple] = []  # (row, ts) from a snapshot
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self) -> None:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=min(self._capacity, 32),
+                thread_name_prefix=f"{self.name}-io")
+        self._fn.open()
+        # re-submit requests that were in flight at the snapshot
+        for row, ts in self._restored_rows:
+            self._pending.append(self._submit(tuple(row), int(ts)))
+        self._restored_rows = []
+
+    def close(self) -> None:
+        self._fn.close()
+        if self._own_executor and self._executor is not None:
+            self._executor.shutdown(wait=False)
+
+    # -- request plumbing --------------------------------------------------
+    def _submit(self, row: tuple, ts: int, attempts: int = 1) -> _Entry:
+        result = self._fn.async_invoke(row, ts)
+        if not isinstance(result, Future):
+            f: Future = Future()
+            f.set_result(result)
+            result = f
+        deadline = (time.monotonic() + self._timeout_ms / 1000.0
+                    if self._timeout_ms is not None else None)
+        return _Entry(row, ts, result, deadline, attempts)
+
+    def _fail_or_retry(self, e: _Entry, why: str) -> str:
+        """Timeout or exceptional completion: schedule a retry (non-blocking
+        backoff via not_before) or report terminal failure."""
+        if e.attempts < self._retry.max_attempts:
+            e.future = None  # resubmitted once the backoff gate opens
+            e.not_before = time.monotonic() + self._retry.delay_ms / 1000.0
+            return "waiting"
+        return why
+
+    def _entry_state(self, e: _Entry) -> str:
+        """done | waiting | timed_out | failed."""
+        now = time.monotonic()
+        if e.future is None:  # waiting out a retry backoff
+            if now < e.not_before:
+                return "waiting"
+            new = self._submit(e.row, e.ts, e.attempts + 1)
+            e.future, e.deadline, e.attempts = \
+                new.future, new.deadline, new.attempts
+        if e.future.done():
+            if e.future.exception() is not None:
+                # exceptional completion retries like a timeout (reference
+                # AsyncRetryStrategies retry on exceptions)
+                return self._fail_or_retry(e, "failed")
+            return "done"
+        if e.deadline is not None and now > e.deadline:
+            return self._fail_or_retry(e, "timed_out")
+        return "waiting"
+
+    def _resolve(self, e: _Entry, out_rows: list, out_ts: list,
+                 state: str) -> None:
+        if state in ("timed_out", "failed"):
+            if self._on_timeout == "fail":
+                if state == "failed":
+                    raise e.future.exception()
+                raise TimeoutError(
+                    f"async request timed out after {e.attempts} attempts "
+                    f"for row {e.row!r}")
+            result = self._fn.timeout(e.row)
+        else:
+            result = e.future.result()
+        if result is None:
+            return
+        rows = ([result] if isinstance(result, tuple)
+                else list(result))
+        for r in rows:
+            out_rows.append(tuple(r) if not isinstance(r, tuple) else r)
+            out_ts.append(e.ts)
+
+    def _drain(self, wait_all: bool, out_rows: list, out_ts: list) -> None:
+        """Pop completed entries. ordered: only from the head; unordered:
+        anywhere. wait_all blocks until the queue is empty (barrier/finish/
+        capacity)."""
+        while self._pending:
+            if self._mode == "ordered":
+                head = self._pending[0]
+                state = self._entry_state(head)
+                if state == "waiting":
+                    if not wait_all:
+                        return
+                    time.sleep(0.001)
+                    continue
+                self._pending.popleft()
+                self._resolve(head, out_rows, out_ts, state)
+            else:
+                progressed = False
+                for _ in range(len(self._pending)):
+                    e = self._pending.popleft()
+                    state = self._entry_state(e)
+                    if state == "waiting":
+                        self._pending.append(e)
+                    else:
+                        self._resolve(e, out_rows, out_ts, state)
+                        progressed = True
+                if not self._pending:
+                    return
+                if not wait_all:
+                    return
+                if not progressed:
+                    time.sleep(0.001)
+
+    def _emit(self, out_rows: list, out_ts: list) -> None:
+        if not out_rows:
+            return
+        # from_rows_infer re-promotes per column even with a schema (the
+        # MapOperator pattern), so later wider values never truncate
+        batch, self.out_schema = RecordBatch.from_rows_infer(
+            self.out_schema, out_rows, out_ts)
+        self.output.emit(batch)
+
+    # -- data path ---------------------------------------------------------
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        names = [f.name for f in batch.schema.fields]
+        cols = [batch.column(n) for n in names]
+        ts_arr = batch.timestamps
+        out_rows: list = []
+        out_ts: list = []
+        for i in range(batch.n):
+            row = tuple(_scalar(c[i]) for c in cols)
+            while len(self._pending) >= self._capacity:
+                # full queue = backpressure (reference blocks the mailbox)
+                before = len(self._pending)
+                self._drain(wait_all=False, out_rows=out_rows,
+                            out_ts=out_ts)
+                if len(self._pending) == before:
+                    time.sleep(0.001)
+            self._pending.append(self._submit(row, int(ts_arr[i])))
+            self._drain(wait_all=False, out_rows=out_rows, out_ts=out_ts)
+        self._emit(out_rows, out_ts)
+
+    def process_watermark(self, watermark) -> None:
+        # all requests for records before the watermark must resolve first
+        out_rows: list = []
+        out_ts: list = []
+        self._drain(wait_all=True, out_rows=out_rows, out_ts=out_ts)
+        self._emit(out_rows, out_ts)
+        super().process_watermark(watermark)
+
+    def advance_processing_time(self, now_ms: int) -> None:
+        out_rows: list = []
+        out_ts: list = []
+        self._drain(wait_all=False, out_rows=out_rows, out_ts=out_ts)
+        self._emit(out_rows, out_ts)
+
+    def finish(self) -> None:
+        out_rows: list = []
+        out_ts: list = []
+        self._drain(wait_all=True, out_rows=out_rows, out_ts=out_ts)
+        self._emit(out_rows, out_ts)
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        """Snapshot the queue of unresolved input elements (reference
+        AsyncWaitOperator.snapshotState). The barrier has already been
+        broadcast by the task, so results resolving later are emitted
+        post-barrier — covered exactly by re-submitting these elements on
+        restore (no drain here, which would leak post-barrier emissions
+        out of checkpoint N)."""
+        return {"operator": {
+            "pending": [(list(e.row), e.ts) for e in self._pending]}}
+
+    def initialize_state(self, keyed_snapshots: list,
+                         operator_snapshot) -> None:
+        if operator_snapshot and operator_snapshot.get("pending"):
+            self._restored_rows = [(tuple(r), int(t))
+                                   for r, t in operator_snapshot["pending"]]

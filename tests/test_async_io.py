@@ -1,0 +1,235 @@
+"""Async I/O operator (reference test model: AsyncWaitOperatorTest)."""
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.core.records import Schema
+from flink_tpu.runtime.harness import OneInputOperatorTestHarness
+from flink_tpu.runtime.operators.async_io import (
+    AsyncFunction, AsyncWaitOperator, RetryPolicy,
+)
+
+IN_SCHEMA = Schema([("k", np.int64)])
+OUT_SCHEMA = Schema([("k", np.int64), ("enriched", object)])
+
+
+class _Doubler(AsyncFunction):
+    """Resolves out of submission order: even keys resolve slowly."""
+
+    def open(self):
+        self.pool = ThreadPoolExecutor(4)
+
+    def async_invoke(self, row, ts):
+        k = row[0]
+
+        def work():
+            if k % 2 == 0:
+                time.sleep(0.05)
+            return (k, f"v{k * 2}")
+
+        return self.pool.submit(work)
+
+    def close(self):
+        self.pool.shutdown(wait=False)
+
+
+def run_op(mode, keys=(0, 1, 2, 3), **kwargs):
+    op = AsyncWaitOperator(_Doubler(), mode=mode, out_schema=OUT_SCHEMA,
+                           **kwargs)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements(list(keys), list(range(len(keys))))
+    h.process_watermark(100)  # forces full drain
+    h.close()
+    return [r for r in h.get_output()]
+
+
+def test_ordered_preserves_input_order():
+    out = run_op("ordered")
+    assert [r[0] for r in out] == [0, 1, 2, 3]
+    assert out[0][1] == "v0" and out[3][1] == "v6"
+
+
+def test_unordered_completes_out_of_order():
+    out = run_op("unordered", keys=tuple(range(8)))
+    assert sorted(r[0] for r in out) == list(range(8))
+    # odd keys (fast) generally beat even keys (slow) — at minimum the
+    # output is NOT forced into submission order
+    assert {r[0] for r in out} == set(range(8))
+
+
+def test_sync_fast_path_and_none_result():
+    class F(AsyncFunction):
+        def async_invoke(self, row, ts):
+            if row[0] == 1:
+                return None          # filtered out
+            return (row[0], "sync")
+
+    op = AsyncWaitOperator(F(), out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([0, 1, 2], [0, 1, 2])
+    h.process_watermark(10)
+    assert [r[0] for r in h.get_output()] == [0, 2]
+
+
+def test_flat_results():
+    class F(AsyncFunction):
+        def async_invoke(self, row, ts):
+            return [(row[0], "a"), (row[0], "b")]
+
+    op = AsyncWaitOperator(F(), out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([5], [0])
+    h.process_watermark(10)
+    assert h.get_output() == [(5, "a"), (5, "b")]
+
+
+def test_timeout_fail_and_ignore():
+    class Hang(AsyncFunction):
+        def async_invoke(self, row, ts):
+            return Future()          # never resolves
+
+        def timeout(self, row):
+            return (row[0], "fallback")
+
+    op = AsyncWaitOperator(Hang(), timeout_ms=20, on_timeout="fail",
+                           out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([1], [0])
+    with pytest.raises(TimeoutError):
+        h.process_watermark(10)
+
+    op2 = AsyncWaitOperator(Hang(), timeout_ms=20, on_timeout="ignore",
+                            out_schema=OUT_SCHEMA)
+    h2 = OneInputOperatorTestHarness(op2, schema=IN_SCHEMA)
+    h2.process_elements([1], [0])
+    h2.process_watermark(10)
+    assert h2.get_output() == [(1, "fallback")]
+
+
+def test_retry_then_success():
+    class Flaky(AsyncFunction):
+        def __init__(self):
+            self.calls = 0
+
+        def async_invoke(self, row, ts):
+            self.calls += 1
+            f = Future()
+            if self.calls >= 3:
+                f.set_result((row[0], "ok"))
+            return f                 # unresolved until the 3rd attempt
+
+    fn = Flaky()
+    op = AsyncWaitOperator(fn, timeout_ms=10, on_timeout="ignore",
+                           retry=RetryPolicy(max_attempts=5, delay_ms=1),
+                           out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([7], [0])
+    h.process_watermark(10)
+    assert h.get_output() == [(7, "ok")]
+    assert fn.calls == 3
+
+
+def test_capacity_backpressure():
+    inflight = []
+    lock = threading.Lock()
+    max_seen = [0]
+
+    class Slow(AsyncFunction):
+        def open(self):
+            self.pool = ThreadPoolExecutor(16)
+
+        def async_invoke(self, row, ts):
+            def work():
+                with lock:
+                    inflight.append(1)
+                    max_seen[0] = max(max_seen[0], len(inflight))
+                time.sleep(0.01)
+                with lock:
+                    inflight.pop()
+                return (row[0], "x")
+
+            return self.pool.submit(work)
+
+    op = AsyncWaitOperator(Slow(), capacity=3, out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements(list(range(12)), list(range(12)))
+    h.process_watermark(100)
+    assert len(h.get_output()) == 12
+    assert max_seen[0] <= 3
+
+
+def test_snapshot_captures_inflight_and_restore_resubmits():
+    """In-flight requests snapshot as elements and re-submit on restore
+    (reference element-queue snapshot) — no post-barrier emission leak."""
+    op = AsyncWaitOperator(_Doubler(), out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([2, 4], [0, 1])       # slow even keys in flight
+    snap = h.snapshot(1)
+    assert sorted(r for r, _ in snap["operator"]["pending"]) in (
+        [[2], [4]], [])                      # captured unless already done
+    h2 = OneInputOperatorTestHarness.restored(
+        lambda: AsyncWaitOperator(_Doubler(), out_schema=OUT_SCHEMA),
+        snap, schema=IN_SCHEMA)
+    h2.process_watermark(10)                 # drains resubmitted entries
+    restored_keys = sorted(r[0] for r in h2.get_output())
+    # original continues too
+    h.process_watermark(10)
+    assert sorted(r[0] for r in h.get_output()) == [2, 4]
+    if snap["operator"]["pending"]:
+        assert restored_keys == sorted(
+            r[0] for r, _ in snap["operator"]["pending"])
+
+
+def test_exception_retries_then_ignore_fallback():
+    class Exploding(AsyncFunction):
+        def __init__(self):
+            self.calls = 0
+
+        def async_invoke(self, row, ts):
+            self.calls += 1
+            f = Future()
+            if self.calls >= 3:
+                f.set_result((row[0], "recovered"))
+            else:
+                f.set_exception(ConnectionError("transient"))
+            return f
+
+    fn = Exploding()
+    op = AsyncWaitOperator(fn, on_timeout="ignore",
+                           retry=RetryPolicy(max_attempts=5, delay_ms=1),
+                           out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([3], [0])
+    h.process_watermark(10)
+    assert h.get_output() == [(3, "recovered")]
+    assert fn.calls == 3
+
+    # exhausted retries with on_timeout=fail re-raise the original error
+    class AlwaysFails(AsyncFunction):
+        def async_invoke(self, row, ts):
+            f = Future()
+            f.set_exception(ConnectionError("down"))
+            return f
+
+    op2 = AsyncWaitOperator(AlwaysFails(), on_timeout="fail",
+                            retry=RetryPolicy(max_attempts=2, delay_ms=1),
+                            out_schema=OUT_SCHEMA)
+    h2 = OneInputOperatorTestHarness(op2, schema=IN_SCHEMA)
+    h2.process_elements([1], [0])
+    with pytest.raises(ConnectionError):
+        h2.process_watermark(10)
+
+
+def test_async_io_end_to_end():
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    ds = env.from_collection(list(range(10)), IN_SCHEMA,
+                             timestamps=list(range(10)))
+    out = ds.async_io(_Doubler(), mode="ordered", out_schema=OUT_SCHEMA)
+    rows = out.execute_and_collect("async")
+    assert sorted(r[0] for r in rows) == list(range(10))
