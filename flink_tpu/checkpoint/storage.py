@@ -74,9 +74,16 @@ class FsCheckpointStorage(CheckpointStorage):
         # set the path BEFORE pickling so a checkpoint load()ed from disk
         # knows where it lives
         checkpoint.external_path = d
+        # block-compressed like the reference's snapshot compression
+        # (io/compression/BlockCompressionFactory); native LZ4-style codec
+        # when built, zlib otherwise — self-describing tag either way
+        from ..native import compress
+        payload = compress(pickle.dumps(
+            checkpoint, protocol=pickle.HIGHEST_PROTOCOL))
         tmp = os.path.join(d, "_metadata.part")
         with open(tmp, "wb") as f:
-            pickle.dump(checkpoint, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(_COMPRESSED_MAGIC)
+            f.write(payload)
         final = os.path.join(d, "_metadata")
         os.replace(tmp, final)  # atomic publish
         return checkpoint
@@ -91,4 +98,11 @@ class FsCheckpointStorage(CheckpointStorage):
         meta = path if path.endswith("_metadata") else os.path.join(path,
                                                                     "_metadata")
         with open(meta, "rb") as f:
-            return pickle.load(f)
+            data = f.read()
+        if data.startswith(_COMPRESSED_MAGIC):
+            from ..native import decompress
+            return pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
+        return pickle.loads(data)  # pre-compression snapshots
+
+
+_COMPRESSED_MAGIC = b"FTCK"

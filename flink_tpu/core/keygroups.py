@@ -178,6 +178,14 @@ def hash_batch(keys: Sequence[Any]) -> np.ndarray:
 
 
 def key_groups_for_hash_batch(hashes: np.ndarray, max_parallelism: int) -> np.ndarray:
-    """Vectorized key_group_for_hash over a uint32 hash array -> int32 groups."""
+    """Vectorized key_group_for_hash over a uint32 hash array -> int32 groups.
+    Routes through the native library when built (flink_tpu/native,
+    bit-exact parity with the numpy path is tested)."""
+    try:
+        from .. import native
+        if native.NATIVE_AVAILABLE and len(hashes) >= 512:
+            return native.key_group_batch(hashes, max_parallelism)
+    except ImportError:
+        pass
     return (murmur_mix(hashes.astype(np.uint32)) % np.int32(max_parallelism)).astype(
         np.int32)

@@ -1,0 +1,329 @@
+// Native host-runtime hot paths for flink-tpu.
+//
+// The reference ships native code where the JVM is too slow or indirect:
+// FRocksDB (C++ LSM state store behind RocksDBKeyedStateBackend.java:114),
+// lz4-java/zstd JNI block compression (io/compression/
+// BlockCompressionFactory.java:68), Netty's native epoll transport, and
+// Unsafe-backed MemorySegments (core/memory/MemorySegment.java:70). This
+// library is the TPU framework's equivalent layer for the HOST side of the
+// runtime (the device side is XLA/Pallas):
+//
+//   * murmur_mix_batch / key_group_batch — vectorized key-group routing
+//     (KeyGroupRangeAssignment.computeKeyGroupForKeyHash) for the exchange
+//     hot path; bit-exact with core/keygroups.murmur_mix.
+//   * block_compress / block_decompress — an LZ4-style byte-oriented block
+//     codec (greedy hash-table matcher, literal/match token stream) used
+//     for checkpoint snapshots and DCN spill framing. Self-describing
+//     frame, NOT interoperable with upstream LZ4 (deliberate: no external
+//     deps), ~lz4-class speed.
+//   * hash index — open-addressing int64 -> slot table (linear probing,
+//     power-of-two capacity) assigning dense slots in insertion order; the
+//     host-side key->row index of the state backends' spill tier (the
+//     RocksDB-replacement risk item in SURVEY.md §7).
+//
+// Built by flink_tpu/native/build.py with g++ -O3; loaded via ctypes
+// (no pybind11 in the image). Every entry point has a numpy fallback in
+// flink_tpu/native/__init__.py, so the Python package works without a
+// toolchain; the native path is an acceleration, not a requirement.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// murmur key-group routing
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline int32_t murmur_mix_one(uint32_t k) {
+    const uint32_t C1 = 0xCC9E2D51u, C2 = 0x1B873593u;
+    k *= C1;
+    k = rotl32(k, 15);
+    k *= C2;
+    uint32_t h = rotl32(k, 13);
+    h = h * 5u + 0xE6546B64u;
+    h ^= 4u;  // len(bytes) == 4
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    int32_t s = (int32_t)h;
+    if (s == INT32_MIN) return 0;      // reference abs() semantics
+    return s < 0 ? -s : s;
+}
+
+void murmur_mix_batch(const uint32_t* codes, int64_t n, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) out[i] = murmur_mix_one(codes[i]);
+}
+
+void key_group_batch(const uint32_t* codes, int64_t n, int32_t max_par,
+                     int32_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = murmur_mix_one(codes[i]) % max_par;
+}
+
+// ---------------------------------------------------------------------------
+// LZ4-style block codec
+//
+// Frame: [u64 raw_len][sequence*]
+// Sequence: token byte = (lit_len_nibble << 4) | match_len_nibble
+//   lit_len_nibble == 15  -> extended length bytes follow (255-run coding)
+//   literals follow
+//   if any input remains: [u16 little-endian offset][match extension if
+//   match_len_nibble == 15]; match length is stored minus MIN_MATCH (4).
+//   A block ends when raw_len bytes have been produced.
+// ---------------------------------------------------------------------------
+
+static const int MIN_MATCH = 4;
+static const int HASH_LOG = 14;
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t seq_hash(uint32_t v) {
+    return (v * 2654435761u) >> (32 - HASH_LOG);
+}
+
+static inline uint8_t* write_len(uint8_t* op, uint64_t len) {
+    while (len >= 255) { *op++ = 255; len -= 255; }
+    *op++ = (uint8_t)len;
+    return op;
+}
+
+// worst case: raw_len + raw_len/255 + 16 (header + final token)
+int64_t block_compress_bound(int64_t raw_len) {
+    return raw_len + raw_len / 255 + 32;
+}
+
+int64_t block_compress(const uint8_t* src, int64_t n, uint8_t* dst) {
+    uint8_t* op = dst;
+    std::memcpy(op, &n, 8);
+    op += 8;
+    if (n == 0) return op - dst;
+
+    int32_t table[1 << HASH_LOG];
+    for (int i = 0; i < (1 << HASH_LOG); ++i) table[i] = -1;
+
+    const uint8_t* anchor = src;       // start of pending literals
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    const uint8_t* mlimit = iend - MIN_MATCH;  // last position matchable
+
+    while (ip <= mlimit) {
+        uint32_t h = seq_hash(read32(ip));
+        int64_t cand = table[h];
+        table[h] = (int32_t)(ip - src);
+        if (cand >= 0 && (ip - src) - cand <= 65535 &&
+            read32(src + cand) == read32(ip)) {
+            // extend the match
+            const uint8_t* match = src + cand;
+            const uint8_t* mi = ip + MIN_MATCH;
+            const uint8_t* mm = match + MIN_MATCH;
+            while (mi < iend && *mi == *mm) { ++mi; ++mm; }
+            uint64_t match_len = (uint64_t)(mi - ip);
+            uint64_t lit_len = (uint64_t)(ip - anchor);
+
+            uint8_t tok_lit = lit_len >= 15 ? 15 : (uint8_t)lit_len;
+            uint64_t mstore = match_len - MIN_MATCH;
+            uint8_t tok_match = mstore >= 15 ? 15 : (uint8_t)mstore;
+            *op++ = (uint8_t)((tok_lit << 4) | tok_match);
+            if (tok_lit == 15) op = write_len(op, lit_len - 15);
+            std::memcpy(op, anchor, lit_len);
+            op += lit_len;
+            uint16_t off = (uint16_t)((ip - src) - cand);
+            std::memcpy(op, &off, 2);
+            op += 2;
+            if (tok_match == 15) op = write_len(op, mstore - 15);
+            ip = mi;
+            anchor = ip;
+        } else {
+            ++ip;
+        }
+    }
+    // trailing literals, token with match nibble unused (no offset follows
+    // because decompression stops at raw_len)
+    uint64_t lit_len = (uint64_t)(iend - anchor);
+    uint8_t tok_lit = lit_len >= 15 ? 15 : (uint8_t)lit_len;
+    *op++ = (uint8_t)(tok_lit << 4);
+    if (tok_lit == 15) op = write_len(op, lit_len - 15);
+    std::memcpy(op, anchor, lit_len);
+    op += lit_len;
+    return op - dst;
+}
+
+// returns raw length, or -1 on corrupt input
+int64_t block_raw_len(const uint8_t* src, int64_t n) {
+    if (n < 8) return -1;
+    int64_t raw;
+    std::memcpy(&raw, src, 8);
+    return raw;
+}
+
+int64_t block_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                         int64_t dst_cap) {
+    int64_t raw = block_raw_len(src, n);
+    if (raw < 0 || raw > dst_cap) return -1;
+    const uint8_t* ip = src + 8;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + raw;
+
+    while (op < oend) {
+        if (ip >= iend) return -1;
+        uint8_t tok = *ip++;
+        uint64_t lit_len = tok >> 4;
+        if (lit_len == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit_len += b;
+            } while (b == 255);
+        }
+        if (ip + lit_len > iend || op + lit_len > oend) return -1;
+        std::memcpy(op, ip, lit_len);
+        ip += lit_len;
+        op += lit_len;
+        if (op >= oend) break;  // trailing-literal sequence
+        if (ip + 2 > iend) return -1;
+        uint16_t off;
+        std::memcpy(&off, ip, 2);
+        ip += 2;
+        uint64_t match_len = (tok & 15);
+        if (match_len == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                match_len += b;
+            } while (b == 255);
+        }
+        match_len += MIN_MATCH;
+        if (off == 0 || op - dst < off || op + match_len > oend) return -1;
+        const uint8_t* match = op - off;
+        // overlapping copy must run forward byte-by-byte
+        for (uint64_t i = 0; i < match_len; ++i) op[i] = match[i];
+        op += match_len;
+    }
+    return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// open-addressing int64 -> dense slot hash index
+// ---------------------------------------------------------------------------
+
+struct HashIndex {
+    int64_t* keys;       // EMPTY = sentinel
+    int32_t* slots;
+    int64_t cap;         // power of two
+    int64_t size;
+};
+
+static const int64_t EMPTY_KEY = INT64_MIN;
+
+static inline uint64_t hash64(int64_t k) {
+    uint64_t x = (uint64_t)k;
+    x ^= x >> 33;
+    x *= 0xFF51AFD7ED558CCDull;
+    x ^= x >> 33;
+    x *= 0xC4CEB9FE1A85EC53ull;
+    x ^= x >> 33;
+    return x;
+}
+
+void* hi_create(int64_t capacity) {
+    int64_t cap = 16;
+    while (cap < capacity * 2) cap <<= 1;  // keep load factor <= 0.5
+    HashIndex* hi = (HashIndex*)std::malloc(sizeof(HashIndex));
+    hi->keys = (int64_t*)std::malloc(cap * sizeof(int64_t));
+    hi->slots = (int32_t*)std::malloc(cap * sizeof(int32_t));
+    for (int64_t i = 0; i < cap; ++i) hi->keys[i] = EMPTY_KEY;
+    hi->cap = cap;
+    hi->size = 0;
+    return hi;
+}
+
+void hi_free(void* p) {
+    HashIndex* hi = (HashIndex*)p;
+    std::free(hi->keys);
+    std::free(hi->slots);
+    std::free(hi);
+}
+
+int64_t hi_size(void* p) { return ((HashIndex*)p)->size; }
+
+static void hi_grow(HashIndex* hi) {
+    int64_t old_cap = hi->cap;
+    int64_t* old_keys = hi->keys;
+    int32_t* old_slots = hi->slots;
+    hi->cap <<= 1;
+    hi->keys = (int64_t*)std::malloc(hi->cap * sizeof(int64_t));
+    hi->slots = (int32_t*)std::malloc(hi->cap * sizeof(int32_t));
+    for (int64_t i = 0; i < hi->cap; ++i) hi->keys[i] = EMPTY_KEY;
+    uint64_t mask = hi->cap - 1;
+    for (int64_t i = 0; i < old_cap; ++i) {
+        if (old_keys[i] == EMPTY_KEY) continue;
+        uint64_t j = hash64(old_keys[i]) & mask;
+        while (hi->keys[j] != EMPTY_KEY) j = (j + 1) & mask;
+        hi->keys[j] = old_keys[i];
+        hi->slots[j] = old_slots[i];
+    }
+    std::free(old_keys);
+    std::free(old_slots);
+}
+
+// lookup-or-insert: out_slots[i] = dense slot of keys[i] (new slots assigned
+// in first-seen order continuing from the current size)
+void hi_upsert_batch(void* p, const int64_t* keys, int64_t n,
+                     int32_t* out_slots) {
+    HashIndex* hi = (HashIndex*)p;
+    for (int64_t i = 0; i < n; ++i) {
+        if (hi->size * 2 >= hi->cap) hi_grow(hi);
+        uint64_t mask = hi->cap - 1;
+        int64_t k = keys[i] == EMPTY_KEY ? EMPTY_KEY + 1 : keys[i];
+        uint64_t j = hash64(k) & mask;
+        while (true) {
+            if (hi->keys[j] == EMPTY_KEY) {
+                hi->keys[j] = k;
+                hi->slots[j] = (int32_t)hi->size++;
+                out_slots[i] = hi->slots[j];
+                break;
+            }
+            if (hi->keys[j] == k) {
+                out_slots[i] = hi->slots[j];
+                break;
+            }
+            j = (j + 1) & mask;
+        }
+    }
+}
+
+// lookup only: -1 for absent keys
+void hi_lookup_batch(void* p, const int64_t* keys, int64_t n,
+                     int32_t* out_slots) {
+    HashIndex* hi = (HashIndex*)p;
+    uint64_t mask = hi->cap - 1;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t k = keys[i] == EMPTY_KEY ? EMPTY_KEY + 1 : keys[i];
+        uint64_t j = hash64(k) & mask;
+        out_slots[i] = -1;
+        while (hi->keys[j] != EMPTY_KEY) {
+            if (hi->keys[j] == k) {
+                out_slots[i] = hi->slots[j];
+                break;
+            }
+            j = (j + 1) & mask;
+        }
+    }
+}
+
+}  // extern "C"

@@ -20,14 +20,12 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable, Optional
-
-import numpy as np
+from typing import Any, Optional
 
 from ...core.records import RecordBatch, Schema, scalar as _scalar
-from .base import OneInputOperator, OperatorContext, Output
+from .base import OneInputOperator
 
 __all__ = ["AsyncFunction", "AsyncWaitOperator", "RetryPolicy"]
 
@@ -71,12 +69,14 @@ class _Entry:
 
 
 class AsyncWaitOperator(OneInputOperator):
+    DEFAULT_TIMEOUT_MS = 60_000  # the reference makes a timeout mandatory;
+    # a hung request must never stall the pipeline forever
+
     def __init__(self, fn: AsyncFunction, capacity: int = 100,
                  timeout_ms: Optional[int] = None, mode: str = "ordered",
                  retry: Optional[RetryPolicy] = None,
                  on_timeout: str = "fail",
                  out_schema: Optional[Schema] = None,
-                 executor: Optional[ThreadPoolExecutor] = None,
                  name: str = "AsyncWait"):
         super().__init__(name)
         if mode not in ("ordered", "unordered"):
@@ -85,22 +85,17 @@ class AsyncWaitOperator(OneInputOperator):
             raise ValueError("on_timeout must be fail|ignore")
         self._fn = fn
         self._capacity = capacity
-        self._timeout_ms = timeout_ms
+        self._timeout_ms = (self.DEFAULT_TIMEOUT_MS if timeout_ms is None
+                            else timeout_ms)
         self._mode = mode
         self._retry = retry or RetryPolicy(max_attempts=1)
         self._on_timeout = on_timeout
         self.out_schema = out_schema
-        self._own_executor = executor is None
-        self._executor = executor
         self._pending: deque[_Entry] = deque()
         self._restored_rows: list[tuple] = []  # (row, ts) from a snapshot
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> None:
-        if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=min(self._capacity, 32),
-                thread_name_prefix=f"{self.name}-io")
         self._fn.open()
         # re-submit requests that were in flight at the snapshot
         for row, ts in self._restored_rows:
@@ -109,12 +104,16 @@ class AsyncWaitOperator(OneInputOperator):
 
     def close(self) -> None:
         self._fn.close()
-        if self._own_executor and self._executor is not None:
-            self._executor.shutdown(wait=False)
 
     # -- request plumbing --------------------------------------------------
     def _submit(self, row: tuple, ts: int, attempts: int = 1) -> _Entry:
-        result = self._fn.async_invoke(row, ts)
+        try:
+            result = self._fn.async_invoke(row, ts)
+        except Exception as exc:  # noqa: BLE001 - sync raise == failed future
+            # a synchronous raise gets the same retry/ignore treatment as an
+            # exceptionally-completed future
+            result = Future()
+            result.set_exception(exc)
         if not isinstance(result, Future):
             f: Future = Future()
             f.set_result(result)
@@ -127,6 +126,8 @@ class AsyncWaitOperator(OneInputOperator):
         """Timeout or exceptional completion: schedule a retry (non-blocking
         backoff via not_before) or report terminal failure."""
         if e.attempts < self._retry.max_attempts:
+            if e.future is not None:
+                e.future.cancel()  # free queued work in the user's pool
             e.future = None  # resubmitted once the backoff gate opens
             e.not_before = time.monotonic() + self._retry.delay_ms / 1000.0
             return "waiting"

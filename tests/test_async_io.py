@@ -225,6 +225,30 @@ def test_exception_retries_then_ignore_fallback():
         h2.process_watermark(10)
 
 
+def test_sync_raise_gets_retry_and_ignore_semantics():
+    """async_invoke raising synchronously behaves exactly like a failed
+    future (regression: it used to bypass RetryPolicy entirely)."""
+    class RaisesThenWorks(AsyncFunction):
+        def __init__(self):
+            self.calls = 0
+
+        def async_invoke(self, row, ts):
+            self.calls += 1
+            if self.calls < 3:
+                raise ConnectionError("refused")
+            return (row[0], "up")
+
+    fn = RaisesThenWorks()
+    op = AsyncWaitOperator(fn, on_timeout="ignore",
+                           retry=RetryPolicy(max_attempts=5, delay_ms=1),
+                           out_schema=OUT_SCHEMA)
+    h = OneInputOperatorTestHarness(op, schema=IN_SCHEMA)
+    h.process_elements([4], [0])
+    h.process_watermark(10)
+    assert h.get_output() == [(4, "up")]
+    assert fn.calls == 3
+
+
 def test_async_io_end_to_end():
     env = StreamExecutionEnvironment()
     env.set_parallelism(2)
