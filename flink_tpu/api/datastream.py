@@ -129,17 +129,11 @@ class DataStream:
         its own copy, so open resources (thread pools, clients) in
         ``open()``, not ``__init__`` — the reference RichFunction
         pattern."""
-        import copy
+        from ..core.functions import copy_per_subtask as make_fn_base
         from ..runtime.operators.async_io import AsyncWaitOperator
 
         def make_fn():
-            try:
-                return copy.deepcopy(fn)
-            except Exception as e:
-                raise ValueError(
-                    f"AsyncFunction {type(fn).__name__} is not copyable "
-                    f"per subtask ({e!r}); create connections/pools in "
-                    "open() instead of __init__") from e
+            return make_fn_base(fn)
 
         return self._one_input(
             name, lambda: AsyncWaitOperator(

@@ -243,10 +243,23 @@ def build_restore_map(checkpoint: CompletedCheckpoint,
                     redistributed[op_key] = OperatorStateBackend.redistribute(
                         op_snaps, vertex.parallelism)
 
+        if not same_par and any(
+                s.get("inflight") or s.get("inflight1") or s.get("inflight2")
+                for s in old.values()):
+            # early reference versions had the same restriction: unaligned
+            # channel state cannot be re-distributed across parallelisms
+            raise ValueError(
+                f"cannot rescale vertex {vid} from an unaligned checkpoint "
+                "with in-flight data; take an aligned checkpoint/savepoint "
+                "first")
+
         for sub in range(vertex.parallelism):
             task_snap: dict[str, Any] = {}
             if same_par and sub in old:
                 task_snap["reader"] = old[sub].get("reader")
+                for fk in ("inflight", "inflight1", "inflight2"):
+                    if old[sub].get(fk):
+                        task_snap[fk] = old[sub][fk]
             chain_map: dict[str, dict] = {}
             for op_key in op_keys:
                 keyed_list = []

@@ -116,6 +116,8 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                         for _ in range(src.parallelism)]
 
     aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
+    unaligned = config.get(CheckpointingOptions.UNALIGNED)
+    alignment_timeout = config.get(CheckpointingOptions.ALIGNMENT_TIMEOUT)
 
     for vid, vertex in job_graph.vertices.items():
         out_edges = [(ei, e) for ei, e in enumerate(job_graph.edges)
@@ -173,9 +175,16 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 task = TwoInputStreamTask.__new__(TwoInputStreamTask)
                 StreamTask.__init__(task, task_id, ctx, writers, job, config,
                                     side_writers=side_writers)
-                task.gates = [InputGate(per_input[0], aligned=aligned),
-                              InputGate(per_input[1], aligned=aligned)]
+                task.gates = [
+                    InputGate(per_input[0], aligned=aligned,
+                              unaligned=unaligned and aligned,
+                              alignment_timeout_s=alignment_timeout),
+                    InputGate(per_input[1], aligned=aligned,
+                              unaligned=unaligned and aligned,
+                              alignment_timeout_s=alignment_timeout)]
                 task._gate_barrier = [None, None]
+                task._unaligned_pending = None
+                task._restored_inflight = [[], []]
                 task.chain = OperatorChain(
                     ops, ctx, task.make_tail_output(),
                     side_outputs=_side_outputs_map(side_writers, metrics))
@@ -187,12 +196,16 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 for ei, e in in_edges:
                     for s in range(len(channels[ei])):
                         in_channels.append(channels[ei][s][sub])
-                gate = InputGate(in_channels, aligned=aligned)
+                gate = InputGate(in_channels, aligned=aligned,
+                                 unaligned=unaligned and aligned,
+                                 alignment_timeout_s=alignment_timeout)
                 ops = [n.operator_factory() for n in vertex.chained_nodes]
                 task = OneInputStreamTask.__new__(OneInputStreamTask)
                 StreamTask.__init__(task, task_id, ctx, writers, job, config,
                                     side_writers=side_writers)
                 task.gate = gate
+                task._restored_inflight = []
+                task._unaligned_pending = None
                 task.chain = OperatorChain(
                     ops, ctx, task.make_tail_output(),
                     side_outputs=_side_outputs_map(side_writers, metrics))

@@ -28,6 +28,21 @@ ACC = TypeVar("ACC")
 KEY = TypeVar("KEY")
 
 
+def copy_per_subtask(fn):
+    """Per-subtask function copy (reference: user functions are serialized
+    into each task, so instances are never shared). A function that cannot
+    be copied must create its resources in open(), not __init__ — sharing
+    silently would cross-wire state across subtasks."""
+    import copy
+    try:
+        return copy.deepcopy(fn)
+    except Exception as e:
+        raise ValueError(
+            f"function {type(fn).__name__} is not copyable per subtask "
+            f"({e!r}); create connections/pools/handles in open() instead "
+            "of __init__") from e
+
+
 class RuntimeContext:
     """What a rich function sees at runtime (reference RuntimeContext)."""
 

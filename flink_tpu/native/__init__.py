@@ -146,7 +146,6 @@ def compress(data: bytes) -> bytes:
         n = len(data)
         bound = _loaded.block_compress_bound(n)
         out = np.empty(bound, np.uint8)
-        src = np.frombuffer(data, np.uint8) if n else np.empty(0, np.uint8)
         written = _loaded.block_compress(
             _u8p(data), n, out.ctypes.data_as(
                 ctypes.POINTER(ctypes.c_uint8)))

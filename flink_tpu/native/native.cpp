@@ -226,6 +226,10 @@ struct HashIndex {
     int32_t* slots;
     int64_t cap;         // power of two
     int64_t size;
+    // INT64_MIN is the table sentinel, so that one key lives out-of-band
+    // (remapping it would collide with INT64_MIN+1)
+    int32_t min_key_slot;
+    bool has_min_key;
 };
 
 static const int64_t EMPTY_KEY = INT64_MIN;
@@ -249,6 +253,8 @@ void* hi_create(int64_t capacity) {
     for (int64_t i = 0; i < cap; ++i) hi->keys[i] = EMPTY_KEY;
     hi->cap = cap;
     hi->size = 0;
+    hi->min_key_slot = -1;
+    hi->has_min_key = false;
     return hi;
 }
 
@@ -287,9 +293,17 @@ void hi_upsert_batch(void* p, const int64_t* keys, int64_t n,
                      int32_t* out_slots) {
     HashIndex* hi = (HashIndex*)p;
     for (int64_t i = 0; i < n; ++i) {
+        if (keys[i] == EMPTY_KEY) {
+            if (!hi->has_min_key) {
+                hi->has_min_key = true;
+                hi->min_key_slot = (int32_t)hi->size++;
+            }
+            out_slots[i] = hi->min_key_slot;
+            continue;
+        }
         if (hi->size * 2 >= hi->cap) hi_grow(hi);
         uint64_t mask = hi->cap - 1;
-        int64_t k = keys[i] == EMPTY_KEY ? EMPTY_KEY + 1 : keys[i];
+        int64_t k = keys[i];
         uint64_t j = hash64(k) & mask;
         while (true) {
             if (hi->keys[j] == EMPTY_KEY) {
@@ -313,7 +327,11 @@ void hi_lookup_batch(void* p, const int64_t* keys, int64_t n,
     HashIndex* hi = (HashIndex*)p;
     uint64_t mask = hi->cap - 1;
     for (int64_t i = 0; i < n; ++i) {
-        int64_t k = keys[i] == EMPTY_KEY ? EMPTY_KEY + 1 : keys[i];
+        if (keys[i] == EMPTY_KEY) {
+            out_slots[i] = hi->has_min_key ? hi->min_key_slot : -1;
+            continue;
+        }
+        int64_t k = keys[i];
         uint64_t j = hash64(k) & mask;
         out_slots[i] = -1;
         while (hi->keys[j] != EMPTY_KEY) {

@@ -82,11 +82,27 @@ class GateEvent:
 
 
 class InputGate:
-    """Merges N input channels with barrier alignment + watermark valve."""
+    """Merges N input channels with barrier alignment + watermark valve.
 
-    def __init__(self, channels: list[Channel], aligned: bool = True):
+    Barrier modes (reference SingleCheckpointBarrierHandler.java:64 /
+    CheckpointBarrierTracker / alternating aligned-unaligned):
+    * aligned exactly-once (default): a channel that delivered its barrier
+      is blocked until every channel's barrier arrived;
+    * at-least-once (aligned=False): barriers counted, nothing blocks;
+    * unaligned (unaligned=True): the FIRST barrier fires immediately and
+      pre-barrier batches still queued on the other channels are captured
+      into the checkpoint as in-flight data while processing continues;
+    * alignment timeout (alignment_timeout_s > 0): an aligned checkpoint
+      escalates to unaligned when alignment stalls longer than the timeout
+      (reference BarrierAlignmentUtil timeout escalation).
+    """
+
+    def __init__(self, channels: list[Channel], aligned: bool = True,
+                 unaligned: bool = False, alignment_timeout_s: float = 0.0):
         self.channels = channels
         self.aligned = aligned
+        self.unaligned = unaligned
+        self.alignment_timeout_s = alignment_timeout_s
         n = len(channels)
         self._blocked = [False] * n          # barrier-aligned channels
         self._ended = [False] * n
@@ -97,6 +113,60 @@ class InputGate:
         self._combined_wm = MIN_TIMESTAMP
         self._rr = 0                         # fair round-robin pointer
         self.alignment_start: float = 0.0
+        # unaligned capture state
+        self._capturing: set[int] = set()    # channels still pre-barrier
+        self._capture_barrier: Optional[CheckpointBarrier] = None
+        self.captured: list = []             # in-flight elements
+
+    # -- unaligned capture -------------------------------------------------
+    @property
+    def capture_active(self) -> bool:
+        return self._capture_barrier is not None
+
+    @property
+    def capture_complete(self) -> bool:
+        return self._capture_barrier is not None and not self._capturing
+
+    def take_captured(self) -> list:
+        out = self.captured
+        self.captured = []
+        self._capture_barrier = None
+        self._capturing = set()
+        return out
+
+    def _start_capture(self, b: CheckpointBarrier) -> GateEvent:
+        """Barrier overtakes: fire now, capture the other channels'
+        pre-barrier data as it arrives."""
+        self.captured = []  # an aborted older capture's data is discarded
+        self._capture_barrier = b
+        self._capturing = {i for i in range(len(self.channels))
+                           if i not in self._barrier_seen
+                           and not self._ended[i]}
+        self._pending_barrier = None
+        self._barrier_seen.clear()
+        self._blocked = [False] * len(self.channels)
+        return GateEvent("barrier", b)
+
+    def begin_capture(self, b: CheckpointBarrier) -> None:
+        """Externally start capture for a barrier that arrived on a SIBLING
+        gate (two-input unaligned checkpoints): every live channel of this
+        gate is pre-barrier until its own barrier shows up."""
+        if self._capture_barrier is not None \
+                and self._capture_barrier.checkpoint_id >= b.checkpoint_id:
+            return
+        self.captured = []
+        self._capture_barrier = b
+        self._capturing = {i for i in range(len(self.channels))
+                           if not self._ended[i]}
+        self._pending_barrier = None
+        self._barrier_seen.clear()
+        self._blocked = [False] * len(self.channels)
+
+    def convert_to_unaligned(self) -> Optional[GateEvent]:
+        """Escalate a stalled aligned checkpoint (alignment timeout)."""
+        if self._pending_barrier is None or self.capture_active:
+            return None
+        return self._start_capture(self._pending_barrier)
 
     # -- watermark valve (reference StatusWatermarkValve) ------------------
     def _recompute_watermark(self) -> Optional[Watermark]:
@@ -126,6 +196,14 @@ class InputGate:
     def poll(self) -> Optional[GateEvent]:
         """Poll one event, fair round-robin over non-blocked channels.
         Returns None when nothing is available right now."""
+        if (self.alignment_timeout_s > 0 and not self.unaligned
+                and self._pending_barrier is not None
+                and not self.capture_active
+                and time.time() - self.alignment_start
+                > self.alignment_timeout_s):
+            ev = self.convert_to_unaligned()
+            if ev is not None:
+                return ev
         n = len(self.channels)
         for off in range(n):
             i = (self._rr + off) % n
@@ -140,6 +218,10 @@ class InputGate:
 
     def _classify(self, i: int, e: Any) -> Optional[GateEvent]:
         if isinstance(e, RecordBatch):
+            if self._capture_barrier is not None and i in self._capturing:
+                # pre-barrier in-flight data rides with the checkpoint AND
+                # is processed normally (reference ChannelStateWriter)
+                self.captured.append(e)
             return GateEvent("batch", e, i)
         if isinstance(e, Watermark):
             self._wm[i] = max(self._wm[i], e.timestamp)
@@ -157,6 +239,7 @@ class InputGate:
             return GateEvent("latency", e, i)
         if isinstance(e, EndOfInput):
             self._ended[i] = True
+            self._capturing.discard(i)  # nothing more to capture from it
             # an ended channel no longer holds back alignment
             if self._pending_barrier is not None:
                 return self._check_alignment_complete()
@@ -165,6 +248,19 @@ class InputGate:
         raise TypeError(f"Unknown stream element {type(e)}")
 
     def _on_barrier(self, i: int, b: CheckpointBarrier) -> Optional[GateEvent]:
+        if self._capture_barrier is not None:
+            if b.checkpoint_id <= self._capture_barrier.checkpoint_id:
+                # this channel caught up to the overtaking barrier
+                self._capturing.discard(i)
+                return None
+            # a newer checkpoint while capturing (max_concurrent > 1):
+            # finish the old capture forcibly and overtake again
+            self._capturing.clear()
+            self._barrier_seen = {i}
+            return self._start_capture(b)
+        if self.unaligned:
+            self._barrier_seen.add(i)
+            return self._start_capture(b)
         if not self.aligned:
             # at-least-once: CheckpointBarrierTracker — count, never block
             self._barrier_seen.add(i)

@@ -141,7 +141,11 @@ class KeyedProcessOperator(OneInputOperator):
     def __init__(self, fn: ProcessFunction, key_extractor: KeyExtractor,
                  out_schema: Optional[Schema] = None, name: str = "KeyedProcess"):
         super().__init__(name)
-        self._fn = fn
+        # per-subtask copy: a shared instance would cross-wire state handles
+        # cached in open() across subtasks (reference: functions are
+        # serialized per task, RichFunction pattern)
+        from ...core.functions import copy_per_subtask
+        self._fn = copy_per_subtask(fn)
         self._key_extractor = key_extractor
         self._out_schema = out_schema
         self._backend = None

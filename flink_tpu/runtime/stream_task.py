@@ -291,10 +291,16 @@ class TwoInputStreamTask(StreamTask):
         self.gates = [gate1, gate2]
         self.chain = chain
         self._gate_barrier: list = [None, None]
+        self._unaligned_pending = None
+        self._restored_inflight: list[list] = [[], []]
 
     def restore_state(self, snapshot: Optional[dict]) -> None:
-        if snapshot and snapshot.get("chain"):
+        if not snapshot:
+            return
+        if snapshot.get("chain"):
             self.chain.initialize_state(snapshot["chain"])
+        self._restored_inflight = [list(snapshot.get("inflight1", ())),
+                                   list(snapshot.get("inflight2", ()))]
 
     def _complete_barrier(self, barrier: CheckpointBarrier) -> None:
         self._gate_barrier = [None, None]
@@ -304,8 +310,35 @@ class TwoInputStreamTask(StreamTask):
             self.task_id, barrier.checkpoint_id, snap)
 
     def _on_barrier(self, gi: int, barrier: CheckpointBarrier) -> None:
+        if self.gates[gi].capture_active:
+            # unaligned: barrier overtook on gate gi — snapshot now, start
+            # capturing the sibling gate too, ack when both drained
+            if self._unaligned_pending is not None:
+                old_b, _ = self._unaligned_pending
+                self._unaligned_pending = None
+                self.reporter.declined_checkpoint(
+                    self.task_id, old_b.checkpoint_id,
+                    "overtaken by a newer unaligned checkpoint")
+            self.broadcast_all(barrier)
+            snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
+            self.gates[1 - gi].begin_capture(barrier)
+            self._unaligned_pending = (barrier, snap)
+            self._maybe_finish_unaligned()
+            return
         self._gate_barrier[gi] = barrier
         self._maybe_complete_barrier()
+
+    def _maybe_finish_unaligned(self) -> None:
+        if self._unaligned_pending is None:
+            return
+        if not all(g.capture_complete for g in self.gates):
+            return
+        barrier, snap = self._unaligned_pending
+        self._unaligned_pending = None
+        snap["inflight1"] = self.gates[0].take_captured()
+        snap["inflight2"] = self.gates[1].take_captured()
+        self.reporter.acknowledge_checkpoint(
+            self.task_id, barrier.checkpoint_id, snap)
 
     def _maybe_complete_barrier(self) -> None:
         b0, b1 = self._gate_barrier
@@ -329,9 +362,14 @@ class TwoInputStreamTask(StreamTask):
 
     def invoke(self) -> None:
         self.chain.open()
+        for gi in (0, 1):
+            for b in self._restored_inflight[gi]:
+                self.chain.process_batch_n(gi, b)
+        self._restored_inflight = [[], []]
         rr = 0
         while not self._cancelled.is_set():
             self._drain_mailbox()
+            self._maybe_finish_unaligned()
             if any(b is not None for b in self._gate_barrier):
                 # the other input may have ended while a barrier was held
                 self._maybe_complete_barrier()
@@ -364,6 +402,7 @@ class TwoInputStreamTask(StreamTask):
             self._advance_processing_time(self.chain)
 
         if not self._cancelled.is_set():
+            self._maybe_finish_unaligned()
             self.chain.finish()
             self.chain.close()
             self.broadcast_all(EndOfInput())
@@ -379,27 +418,64 @@ class OneInputStreamTask(StreamTask):
         super().__init__(task_id, ctx, writers, reporter, config)
         self.gate = gate
         self.chain = chain
+        self._restored_inflight: list = []
+        self._unaligned_pending = None  # (barrier, snapshot) awaiting capture
 
     def restore_state(self, snapshot: Optional[dict]) -> None:
-        if snapshot and snapshot.get("chain"):
+        if not snapshot:
+            return
+        if snapshot.get("chain"):
             self.chain.initialize_state(snapshot["chain"])
+        # unaligned checkpoint: in-flight pre-barrier batches replay first
+        self._restored_inflight = list(snapshot.get("inflight", ()))
 
     def _on_barrier(self, barrier: CheckpointBarrier) -> None:
-        """All barriers aligned: snapshot then forward (reference
-        SubtaskCheckpointCoordinatorImpl.checkpointState: broadcast barrier
-        downstream first, then snapshot operators)."""
+        """Broadcast downstream first, then snapshot (reference
+        SubtaskCheckpointCoordinatorImpl.checkpointState). Aligned: ack
+        immediately. Unaligned (barrier overtook): the state snapshot is
+        taken NOW but the ack waits until the other channels' pre-barrier
+        in-flight data has been captured (reference ChannelStateWriter
+        completing the channel state future)."""
+        if self._unaligned_pending is not None:
+            # a newer checkpoint overtook before capture finished: the older
+            # one can no longer complete on this task
+            old_b, _ = self._unaligned_pending
+            self._unaligned_pending = None
+            self.reporter.declined_checkpoint(
+                self.task_id, old_b.checkpoint_id,
+                "overtaken by a newer unaligned checkpoint")
         self.broadcast_all(barrier)
         snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
+        if self.gate.capture_active and not self.gate.capture_complete:
+            self._unaligned_pending = (barrier, snap)
+            return
+        if self.gate.capture_active:  # capture already complete (1 channel)
+            snap["inflight"] = self.gate.take_captured()
+        self.reporter.acknowledge_checkpoint(
+            self.task_id, barrier.checkpoint_id, snap)
+
+    def _maybe_finish_unaligned(self) -> None:
+        if self._unaligned_pending is None:
+            return
+        if not self.gate.capture_complete:
+            return
+        barrier, snap = self._unaligned_pending
+        self._unaligned_pending = None
+        snap["inflight"] = self.gate.take_captured()
         self.reporter.acknowledge_checkpoint(
             self.task_id, barrier.checkpoint_id, snap)
 
     def invoke(self) -> None:
         self.chain.open()
-        out_watermark_sent = False
+        for batch in self._restored_inflight:
+            # replayed in-flight data precedes any new input
+            self.chain.process_batch(batch)
+        self._restored_inflight = []
         while not self._cancelled.is_set():
             self._drain_mailbox()
             ev = self.gate.poll()
             if ev is None:
+                self._maybe_finish_unaligned()
                 if self.gate.all_ended():
                     break
                 self._advance_processing_time(self.chain)
@@ -417,9 +493,11 @@ class OneInputStreamTask(StreamTask):
                 self.broadcast_all(ev.value)
             elif ev.kind == "idle":
                 self.broadcast_all(ev.value)
+            self._maybe_finish_unaligned()
             self._advance_processing_time(self.chain)
 
         if not self._cancelled.is_set():
+            self._maybe_finish_unaligned()
             self.chain.finish()
             self.chain.close()
             self.broadcast_all(EndOfInput())

@@ -14,3 +14,4 @@ from .descriptors import (  # noqa: F401
     ValueStateDescriptor,
 )
 from .heap import HeapKeyedStateBackend  # noqa: F401
+from .changelog import ChangelogKeyedStateBackend  # noqa: F401

@@ -403,6 +403,8 @@ def test_two_input_barrier_completes_when_other_gate_ends():
     StreamTask.__init__(task, "t#0", ctx, [], rep)
     task.gates = [InputGate([c1]), InputGate([c2])]
     task._gate_barrier = [None, None]
+    task._unaligned_pending = None
+    task._restored_inflight = [[], []]
     task.chain = OperatorChain([op], ctx, CollectingOutput())
     # barrier arrives on gate 0; gate 1 ends without ever sending one
     c1.put(CheckpointBarrier(1, 0))

@@ -109,6 +109,16 @@ def test_hash_index_upsert_lookup():
     assert list(found) == [2, -1, 0]
 
 
+def test_hash_index_int64_min_not_conflated():
+    """INT64_MIN is the table sentinel; it must still be a distinct key
+    (regression: it used to be remapped onto INT64_MIN+1)."""
+    hi = native.HostHashIndex(4)
+    lo = np.iinfo(np.int64).min
+    ks = np.array([lo, lo + 1, lo], dtype=np.int64)
+    assert list(hi.upsert(ks)) == [0, 1, 0]
+    assert list(hi.lookup(np.array([lo + 1, lo], dtype=np.int64))) == [1, 0]
+
+
 def test_hash_index_growth_and_negative_keys():
     hi = native.HostHashIndex(4)
     keys = RNG.integers(-(1 << 62), 1 << 62, 10_000, dtype=np.int64)

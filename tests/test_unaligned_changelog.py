@@ -1,0 +1,286 @@
+"""Unaligned checkpoints + changelog state backend (reference test models:
+UnalignedCheckpointITCase, ChangelogRecoveryITCase)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.config import (
+    CheckpointingOptions, Configuration, PipelineOptions, StateOptions,
+)
+from flink_tpu.core.elements import CheckpointBarrier, EndOfInput, Watermark
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.core.records import RecordBatch, Schema
+from flink_tpu.runtime.channels import InputGate, LocalChannel
+from flink_tpu.state.changelog import ChangelogKeyedStateBackend
+from flink_tpu.state.descriptors import ValueStateDescriptor
+from flink_tpu.state.heap import HeapKeyedStateBackend
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def batch(rows, ts=None):
+    return RecordBatch.from_rows(SCHEMA, rows, ts or [0] * len(rows))
+
+
+# -- unaligned InputGate ---------------------------------------------------
+
+def test_unaligned_barrier_overtakes():
+    c0, c1 = LocalChannel(), LocalChannel()
+    gate = InputGate([c0, c1], aligned=True, unaligned=True)
+    b1 = batch([(1, 10)])
+    b2 = batch([(2, 20)])
+    c1.put(b1)                       # queued pre-barrier data on channel 1
+    c1.put(b2)
+    c0.put(CheckpointBarrier(1, 0))
+    # the barrier fires IMMEDIATELY even though channel 1 hasn't seen it
+    ev = gate.poll()
+    while ev is not None and ev.kind != "barrier":
+        ev = gate.poll()
+    assert ev is not None and ev.kind == "barrier"
+    assert gate.capture_active and not gate.capture_complete
+    # channel 1's pre-barrier batches are captured AND delivered
+    got = []
+    for _ in range(2):
+        e = gate.poll()
+        assert e.kind == "batch"
+        got.append(e.value)
+    assert got == [b1, b2]
+    assert gate.captured == [b1, b2]
+    # channel 1's barrier completes the capture silently
+    c1.put(CheckpointBarrier(1, 0))
+    assert gate.poll() is None
+    assert gate.capture_complete
+    inflight = gate.take_captured()
+    assert inflight == [b1, b2]
+    assert not gate.capture_active
+
+
+def test_unaligned_post_barrier_data_not_captured():
+    c0, c1 = LocalChannel(), LocalChannel()
+    gate = InputGate([c0, c1], aligned=True, unaligned=True)
+    c0.put(CheckpointBarrier(1, 0))
+    assert gate.poll().kind == "barrier"
+    # channel 0 already delivered its barrier: its data is post-barrier
+    post = batch([(9, 90)])
+    c0.put(post)
+    assert gate.poll().kind == "batch"
+    assert gate.captured == []
+
+
+def test_alignment_timeout_escalates():
+    c0, c1 = LocalChannel(), LocalChannel()
+    gate = InputGate([c0, c1], aligned=True, alignment_timeout_s=0.02)
+    c0.put(CheckpointBarrier(5, 0))
+    assert gate.poll() is None       # aligned: blocked, waiting for c1
+    pre = batch([(3, 30)])
+    c1.put(pre)
+    time.sleep(0.03)
+    ev = gate.poll()                 # timeout -> escalate to unaligned
+    assert ev is not None and ev.kind == "barrier"
+    assert ev.value.checkpoint_id == 5
+    assert gate.capture_active
+    assert gate.poll().kind == "batch"
+    assert gate.captured == [pre]
+    c1.put(CheckpointBarrier(5, 0))
+    gate.poll()
+    assert gate.capture_complete
+
+
+def test_unaligned_task_ack_includes_inflight_and_replays():
+    from flink_tpu.runtime.operators.base import (
+        CollectingOutput, OperatorChain, OperatorContext,
+    )
+    from flink_tpu.runtime.operators.simple import BatchFnOperator
+    from flink_tpu.runtime.stream_task import OneInputStreamTask, StreamTask
+
+    class Rep:
+        def __init__(self):
+            self.acks = {}
+
+        def acknowledge_checkpoint(self, tid, cid, snap):
+            self.acks[cid] = snap
+
+        def declined_checkpoint(self, *a):
+            pass
+
+        def task_finished(self, *a):
+            pass
+
+        def task_failed(self, tid, err):
+            raise AssertionError(err)
+
+    def make_task(rep, collected):
+        c0, c1 = LocalChannel(), LocalChannel()
+        ctx = OperatorContext("t", 0, 1, 128)
+        op = BatchFnOperator(lambda b: (collected.extend(b.iter_rows())
+                                        or None), "probe")
+        task = OneInputStreamTask.__new__(OneInputStreamTask)
+        StreamTask.__init__(task, "t#0", ctx, [], rep)
+        task.gate = InputGate([c0, c1], aligned=True, unaligned=True)
+        task.chain = OperatorChain([op], ctx, CollectingOutput())
+        task._restored_inflight = []
+        task._unaligned_pending = None
+        return task, c0, c1
+
+    rep = Rep()
+    seen: list = []
+    task, c0, c1 = make_task(rep, seen)
+    pre = batch([(1, 10), (2, 20)])
+    c1.put(pre)                      # in flight when the barrier overtakes
+    c0.put(CheckpointBarrier(1, 0))
+    c0.put(EndOfInput())
+    c1.put(CheckpointBarrier(1, 0))
+    c1.put(EndOfInput())
+    t = task.start()
+    t.join(5)
+    assert not t.is_alive()
+    assert 1 in rep.acks
+    inflight = rep.acks[1].get("inflight")
+    assert inflight and inflight[0].n == 2
+    assert len(seen) == 2            # processed normally too
+
+    # restore: the captured batches replay before new input
+    rep2 = Rep()
+    seen2: list = []
+    task2, d0, d1 = make_task(rep2, seen2)
+    task2.restore_state({"chain": rep.acks[1]["chain"],
+                         "inflight": inflight})
+    d0.put(EndOfInput())
+    d1.put(EndOfInput())
+    t2 = task2.start()
+    t2.join(5)
+    assert [r[:2] for r in seen2] == [(1, 10), (2, 20)]
+
+
+def test_rescale_from_unaligned_checkpoint_rejected():
+    from flink_tpu.checkpoint.coordinator import build_restore_map
+    from flink_tpu.checkpoint.storage import CompletedCheckpoint
+    from flink_tpu.graph.stream_graph import JobGraph, JobVertex
+    from flink_tpu.graph.stream_graph import StreamNode
+
+    node = StreamNode(1, "op", "one_input", 2, 128)
+    jg = JobGraph(name="j")
+    jg.vertices["v1"] = JobVertex("v1", "op", 2, 128, [node])
+    cp = CompletedCheckpoint(
+        1, 0.0,
+        {"v1#0": {"chain": {}, "inflight": [batch([(1, 1)])]},
+         "v1#1": {"chain": {}}},
+        vertex_parallelism={"v1": 3})   # old par 3 != new par 2
+    with pytest.raises(ValueError, match="unaligned"):
+        build_restore_map(cp, jg)
+
+
+# -- changelog backend -----------------------------------------------------
+
+def make_changelog(mat_interval=3):
+    return ChangelogKeyedStateBackend(
+        KeyGroupRange(0, 127), 128,
+        materialization_interval=mat_interval)
+
+
+def put(backend, key, value, desc):
+    backend.set_current_key(key)
+    state = backend.get_partitioned_state(desc)
+    state.update(value)
+
+
+def test_changelog_snapshot_is_delta():
+    b = make_changelog(mat_interval=10)
+    desc = ValueStateDescriptor("counter")
+    for i in range(100):
+        put(b, i, i * 2, desc)
+    s1 = b.snapshot(1)               # first: materializes, log empty after
+    assert s1["kind"] == "changelog"
+    assert s1["log"] == []
+    put(b, 5, 999, desc)
+    s2 = b.snapshot(2)
+    assert len(s2["log"]) == 1       # O(delta), not O(state)
+    assert s2["mat"] is s1["mat"]    # shared materialized base
+
+
+def test_changelog_restore_replays_log():
+    b = make_changelog(mat_interval=10)
+    desc = ValueStateDescriptor("counter")
+    put(b, 1, 100, desc)
+    b.snapshot(1)
+    put(b, 1, 200, desc)             # after materialization -> in the log
+    put(b, 2, 50, desc)
+    b.set_current_key(2)
+    b.get_partitioned_state(desc).clear()   # rm record
+    snap = b.snapshot(2)
+    assert len(snap["log"]) == 3
+
+    b2 = make_changelog()
+    b2.restore([snap])
+    b2.set_current_key(1)
+    assert b2.get_partitioned_state(desc).value() == 200
+    b2.set_current_key(2)
+    assert b2.get_partitioned_state(desc).value() is None
+
+
+def test_changelog_materialization_interval():
+    b = make_changelog(mat_interval=2)
+    desc = ValueStateDescriptor("x")
+    put(b, 1, 1, desc)
+    s1 = b.snapshot(1)               # materialize #1
+    put(b, 1, 2, desc)
+    s2 = b.snapshot(2)               # delta on base 1
+    put(b, 1, 3, desc)
+    s3 = b.snapshot(3)               # interval reached -> materialize #2
+    assert s1["mat_id"] == 1 and s2["mat_id"] == 1
+    assert s3["mat_id"] == 2 and s3["log"] == []
+
+
+def test_changelog_rescale_filters_key_groups():
+    b = make_changelog(mat_interval=100)
+    desc = ValueStateDescriptor("x")
+    for i in range(200):
+        put(b, i, i, desc)
+    b.snapshot(1)
+    for i in range(200):
+        put(b, i, i + 1000, desc)    # all in the log
+    snap = b.snapshot(2)
+
+    lo = ChangelogKeyedStateBackend(KeyGroupRange(0, 63), 128)
+    hi = ChangelogKeyedStateBackend(KeyGroupRange(64, 127), 128)
+    lo.restore([snap])
+    hi.restore([snap])
+    total = (sum(1 for _ in lo.entries("x"))
+             + sum(1 for _ in hi.entries("x")))
+    assert total == 200
+    for i in (0, 77, 199):
+        owner = lo if _kg(i) <= 63 else hi
+        owner.set_current_key(i)
+        assert owner.get_partitioned_state(desc).value() == i + 1000
+
+
+def _kg(key):
+    from flink_tpu.core.keygroups import assign_to_key_group
+    return assign_to_key_group(key, 128)
+
+
+def test_changelog_backend_via_registry_end_to_end():
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.functions import ProcessFunction
+
+    class Count(ProcessFunction):
+        def open(self, ctx):
+            self.state = ctx.get_state(ValueStateDescriptor("cnt", default=0))
+
+        def process_element(self, value, ctx, out):
+            c = self.state.value() + 1
+            self.state.update(c)
+            out.collect((value[0], c))
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.config.set(StateOptions.BACKEND, "changelog")
+    rows = [(i % 5, i) for i in range(50)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(50)))
+    out = ds.key_by("k").process(Count()).execute_and_collect("cl")
+    finals = {}
+    for k, c in out:
+        finals[k] = max(finals.get(k, 0), c)
+    assert finals == {i: 10 for i in range(5)}
