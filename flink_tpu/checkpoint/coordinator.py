@@ -126,10 +126,13 @@ class CheckpointCoordinator:
     def _complete(self, p: _Pending) -> None:
         vertex_par = {vid: v.parallelism
                       for vid, v in self.job.job_graph.vertices.items()}
+        vertex_uids = {vid: v.uid
+                       for vid, v in self.job.job_graph.vertices.items()
+                       if getattr(v, "uid", "")}
         cp = CompletedCheckpoint(
             checkpoint_id=p.checkpoint_id, timestamp=p.started,
             task_snapshots=dict(p.acks), is_savepoint=p.is_savepoint,
-            vertex_parallelism=vertex_par)
+            vertex_parallelism=vertex_par, vertex_uids=vertex_uids)
         cp = self.storage.store(cp)
         duration = time.time() - p.started
         with self._lock:
@@ -217,12 +220,31 @@ def build_restore_map(checkpoint: CompletedCheckpoint,
         vid, sub = task_id.rsplit("#", 1)
         by_vertex.setdefault(vid, {})[int(sub)] = snap
 
+    # uid -> old vertex id: restore into a resubmitted program whose
+    # generated vertex ids differ (reference operator-uid mapping)
+    uid_to_old = {uid: vid
+                  for vid, uid in (checkpoint.vertex_uids or {}).items()
+                  if vid in by_vertex}
+
     restore: dict[str, dict] = {}
     for vid, vertex in job_graph.vertices.items():
-        old = by_vertex.get(vid)
+        # uid match takes precedence: generated vertex ids can COLLIDE
+        # across resubmissions of a modified program (process-global
+        # counter), so a raw id hit may be the wrong operator
+        uid = getattr(vertex, "uid", "")
+        if uid and uid in uid_to_old:
+            old_vid = uid_to_old[uid]
+            old = by_vertex[old_vid]
+        elif uid and checkpoint.vertex_uids:
+            # uids were recorded but this vertex's isn't among them: a raw
+            # id match would be a collision with a DIFFERENT operator
+            continue
+        else:
+            old_vid = vid
+            old = by_vertex.get(vid)
         if not old:
             continue
-        old_par = checkpoint.vertex_parallelism.get(vid, len(old))
+        old_par = checkpoint.vertex_parallelism.get(old_vid, len(old))
         same_par = old_par == vertex.parallelism
         # union of chain op keys across old subtasks
         op_keys: set[str] = set()

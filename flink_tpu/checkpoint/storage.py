@@ -31,6 +31,9 @@ class CompletedCheckpoint:
     external_path: Optional[str] = None
     # topology at snapshot time, for rescaling restore
     vertex_parallelism: dict[str, int] = field(default_factory=dict)
+    # vertex id -> stable uid, for restore into a RESUBMITTED program whose
+    # generated vertex ids differ (reference operator-uid mapping)
+    vertex_uids: dict[str, str] = field(default_factory=dict)
 
 
 class CheckpointStorage:

@@ -49,6 +49,8 @@ class LocalJob(TaskReporter):
         self._done = threading.Event()
         self.checkpoint_listener: Optional[Callable] = None  # coordinator hook
         self.metrics_registry = None
+        from ..state.queryable import KvStateRegistry
+        self.kv_registry = KvStateRegistry()
 
     # -- TaskReporter ------------------------------------------------------
     def acknowledge_checkpoint(self, task_id: str, checkpoint_id: int,
@@ -132,7 +134,8 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 task_name=vertex.name, subtask_index=sub,
                 parallelism=vertex.parallelism,
                 max_parallelism=vertex.max_parallelism,
-                config=config, metrics=metrics, operator_id=vertex.id)
+                config=config, metrics=metrics, operator_id=vertex.id,
+                kv_registry=job.kv_registry)
 
             # writers: one per (non-side) out edge; side writers by tag
             writers, side_writers = [], {}

@@ -38,6 +38,7 @@ class StreamNode:
     parallelism: int
     max_parallelism: int
     uid: str = ""
+    uid_explicit: bool = False  # user-set via .uid(), vs generated
     chaining_allowed: bool = True
     slot_sharing_group: str = "default"
     operator_factory: Optional[Callable] = None
@@ -119,6 +120,7 @@ def build_stream_graph(sinks: list[Transformation],
         if isinstance(t, SourceTransformation):
             node = StreamNode(t.id, t.name, "source", par, maxp,
                               uid=t.effective_uid,
+                              uid_explicit=t.uid is not None,
                               chaining_allowed=t.chaining_allowed,
                               slot_sharing_group=t.slot_sharing_group,
                               source=t.source,
@@ -126,12 +128,14 @@ def build_stream_graph(sinks: list[Transformation],
         elif isinstance(t, SinkTransformation):
             node = StreamNode(t.id, t.name, "sink", par, maxp,
                               uid=t.effective_uid,
+                              uid_explicit=t.uid is not None,
                               chaining_allowed=t.chaining_allowed,
                               slot_sharing_group=t.slot_sharing_group,
                               operator_factory=t.operator_factory)
         elif isinstance(t, TwoInputTransformation):
             node = StreamNode(t.id, t.name, "two_input", par, maxp,
                               uid=t.effective_uid,
+                              uid_explicit=t.uid is not None,
                               chaining_allowed=t.chaining_allowed,
                               slot_sharing_group=t.slot_sharing_group,
                               operator_factory=t.operator_factory,
@@ -140,6 +144,7 @@ def build_stream_graph(sinks: list[Transformation],
         elif isinstance(t, OneInputTransformation):
             node = StreamNode(t.id, t.name, "one_input", par, maxp,
                               uid=t.effective_uid,
+                              uid_explicit=t.uid is not None,
                               chaining_allowed=t.chaining_allowed,
                               slot_sharing_group=t.slot_sharing_group,
                               operator_factory=t.operator_factory,
@@ -199,6 +204,11 @@ class JobVertex:
     max_parallelism: int
     chained_nodes: list[StreamNode] = field(default_factory=list)
     slot_sharing_group: str = "default"
+    # stable across job submissions: user-set uid, or an auto uid derived
+    # from the vertex's position + chain names (reference auto-generated
+    # operator ids hash the topology for the same reason) — the key
+    # savepoint restore maps operators by
+    uid: str = ""
 
     @property
     def kind(self) -> str:
@@ -269,6 +279,7 @@ def build_job_graph(g: StreamGraph, config: Configuration,
 
     jg = JobGraph(name=name, config=config)
     # build chains in order
+    auto_uid_counts: dict[str, int] = {}
     for nid, node in g.nodes.items():
         if head_of[nid] != nid:
             continue
@@ -283,13 +294,24 @@ def build_job_graph(g: StreamGraph, config: Configuration,
                 break
         head = chain[0]
         vid = f"v{head.id}"
+        chain_name = " -> ".join(n.name for n in chain)
+        if head.uid_explicit:
+            uid = head.uid  # explicitly set by the user
+        else:
+            # auto uid stable across submissions of the same program:
+            # chain shape + occurrence index (transformation ids are a
+            # process-global counter and would NOT survive resubmission)
+            idx = auto_uid_counts.get(chain_name, 0)
+            auto_uid_counts[chain_name] = idx + 1
+            uid = f"auto::{chain_name}::{idx}"
         jg.vertices[vid] = JobVertex(
             id=vid,
-            name=" -> ".join(n.name for n in chain),
+            name=chain_name,
             parallelism=head.parallelism,
             max_parallelism=head.max_parallelism,
             chained_nodes=chain,
-            slot_sharing_group=head.slot_sharing_group)
+            slot_sharing_group=head.slot_sharing_group,
+            uid=uid)
 
     # edges between chains
     for e in g.edges:

@@ -43,6 +43,7 @@ class OperatorContext:
     metrics: Any = None
     processing_time: Callable[[], int] = lambda: int(time.time() * 1000)
     operator_id: str = ""
+    kv_registry: Any = None  # queryable-state registry (local job scope)
 
     @property
     def key_group_range(self) -> KeyGroupRange:
@@ -51,9 +52,11 @@ class OperatorContext:
 
     def create_keyed_backend(self, **kwargs) -> KeyedStateBackend:
         name = self.config.get(StateOptions.BACKEND)
-        return create_backend(name, self.key_group_range,
-                              self.max_parallelism, config=self.config,
-                              **kwargs)
+        backend = create_backend(name, self.key_group_range,
+                                 self.max_parallelism, config=self.config,
+                                 **kwargs)
+        backend.kv_registry = self.kv_registry
+        return backend
 
 
 class Output:

@@ -113,8 +113,17 @@ class KeyedStateBackend:
     def current_key(self) -> Any:
         return self._current_key
 
+    # queryable-state registry, injected by the runtime (OperatorContext)
+    kv_registry: Any = None
+
     # -- state handles -----------------------------------------------------
     def get_partitioned_state(self, descriptor: StateDescriptor) -> State:
+        raise NotImplementedError
+
+    def read_raw(self, state_name: str, key: Any,
+                 namespace: Any = VOID_NAMESPACE) -> Any:
+        """Point read for queryable state (reference InternalKvState
+        .getSerializedValue); None when absent."""
         raise NotImplementedError
 
     # -- introspection / iteration (savepoint reader, window cleanup) ------

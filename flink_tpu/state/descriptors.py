@@ -37,10 +37,22 @@ class StateDescriptor:
     kind: str  # value | list | reducing | aggregating | map
     default: Any = None
     ttl: Optional[StateTtlConfig] = None
+    # queryable-state external name (reference setQueryable); None = private
+    queryable_name: Optional[str] = None
 
     def __post_init__(self):
         if self.kind not in ("value", "list", "reducing", "aggregating", "map"):
             raise ValueError(f"Unknown state kind {self.kind!r}")
+
+    def queryable(self, external_name: str) -> "StateDescriptor":
+        """Expose this state for external queries (reference
+        StateDescriptor.setQueryable). copy+setattr rather than
+        dataclasses.replace: the reducing/aggregating subclasses have
+        custom __init__ signatures."""
+        import copy
+        c = copy.copy(self)
+        object.__setattr__(c, "queryable_name", external_name)
+        return c
 
 
 def ValueStateDescriptor(name: str, default: Any = None,
@@ -68,6 +80,7 @@ class ReducingStateDescriptor(StateDescriptor):
         object.__setattr__(self, "kind", "reducing")
         object.__setattr__(self, "default", None)
         object.__setattr__(self, "ttl", ttl)
+        object.__setattr__(self, "queryable_name", None)
         object.__setattr__(self, "reduce_function", reduce_function)
 
 
@@ -81,4 +94,5 @@ class AggregatingStateDescriptor(StateDescriptor):
         object.__setattr__(self, "kind", "aggregating")
         object.__setattr__(self, "default", None)
         object.__setattr__(self, "ttl", ttl)
+        object.__setattr__(self, "queryable_name", None)
         object.__setattr__(self, "aggregate_function", aggregate_function)

@@ -87,7 +87,19 @@ class HeapKeyedStateBackend(KeyedStateBackend):
             self._descriptors[descriptor.name] = descriptor
             handle = _HANDLE_TYPES[descriptor.kind](self, descriptor)
             self._handles[descriptor.name] = handle
+            if descriptor.queryable_name and self.kv_registry is not None:
+                self.kv_registry.register(descriptor.queryable_name,
+                                          descriptor.name, self)
         return handle
+
+    def read_raw(self, state_name: str, key: Any,
+                 namespace: Any = None) -> Any:
+        import time as _time
+        kg = assign_to_key_group(key, self.max_parallelism)
+        e = self._table(state_name).get(kg, {}).get((key, namespace))
+        if e is None or (e.expiry is not None and e.expiry <= _time.time()):
+            return None
+        return e.value
 
     def keys(self, state_name: str, namespace: Any = None) -> Iterable[Any]:
         for kg_map in self._table(state_name).values():

@@ -1,0 +1,265 @@
+"""State processor API: offline read / transform / bootstrap of savepoints.
+
+Analog of the reference's flink-state-processing-api
+(SavepointReader.java:59, SavepointWriter.java:62, OperatorTransformation):
+savepoints are data, not opaque blobs — read keyed state of any operator as
+plain (key, namespace, value) records, patch or bootstrap state without
+running the streaming job, and write a restorable savepoint.
+
+Operators are addressed by their chain op-key (``"<index>:<OperatorName>"``,
+see OperatorChain) within a vertex; ``SavepointInspector.operators()``
+enumerates what a savepoint contains, so no guessing is needed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from ..checkpoint.storage import (
+    CompletedCheckpoint, FsCheckpointStorage,
+)
+from ..core.keygroups import KeyGroupRange, assign_to_key_group
+from ..state.heap import HeapKeyedStateBackend
+
+__all__ = ["SavepointReader", "SavepointWriter", "KeyedStateRecord"]
+
+
+class KeyedStateRecord(tuple):
+    """(key, namespace, value) with named access."""
+
+    __slots__ = ()
+
+    def __new__(cls, key, namespace, value):
+        return tuple.__new__(cls, (key, namespace, value))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def namespace(self):
+        return self[1]
+
+    @property
+    def value(self):
+        return self[2]
+
+
+def _iter_heap_states(keyed_snapshot: dict, state_name: str
+                      ) -> Iterator[KeyedStateRecord]:
+    """Iterate a heap/changelog-kind keyed snapshot's entries."""
+    snap = keyed_snapshot.get("backend", keyed_snapshot)
+    if snap.get("kind") == "changelog":
+        # materialized base + replayed log = current view; reuse the
+        # backend's own replay for fidelity
+        from ..state.changelog import ChangelogKeyedStateBackend
+        cb = ChangelogKeyedStateBackend(KeyGroupRange(0, (1 << 15) - 1),
+                                        1 << 15)
+        cb.restore([snap])
+        for (key, ns), value in cb.entries(state_name):
+            yield KeyedStateRecord(key, ns, value)
+        return
+    for per_kg in (snap.get("states", {}).get(state_name, {}) or {}).values():
+        for kn, value, _expiry in per_kg:
+            key, ns = tuple(kn) if isinstance(kn, list) else kn
+            yield KeyedStateRecord(key, ns, value)
+
+
+class SavepointReader:
+    """Read an existing savepoint/checkpoint (reference SavepointReader)."""
+
+    def __init__(self, checkpoint: CompletedCheckpoint):
+        self.checkpoint = checkpoint
+
+    @staticmethod
+    def read(path: str) -> "SavepointReader":
+        directory, _, leaf = path.rstrip("/").rpartition("/")
+        storage = FsCheckpointStorage(directory or ".")
+        return SavepointReader(storage.load(path))
+
+    # -- inspection --------------------------------------------------------
+    def vertices(self) -> list[str]:
+        return sorted({tid.rsplit("#", 1)[0]
+                       for tid in self.checkpoint.task_snapshots})
+
+    def operators(self, vertex: Optional[str] = None) -> dict[str, list[str]]:
+        """vertex -> chain op keys present in the savepoint."""
+        out: dict[str, set] = {}
+        for tid, snap in self.checkpoint.task_snapshots.items():
+            vid = tid.rsplit("#", 1)[0]
+            if vertex is not None and vid != vertex:
+                continue
+            out.setdefault(vid, set()).update((snap.get("chain") or {}))
+        return {v: sorted(ks) for v, ks in out.items()}
+
+    def state_names(self, vertex: str, op_key: str) -> list[str]:
+        names: set = set()
+        for snap in self._op_snapshots(vertex, op_key):
+            keyed = snap.get("keyed") or {}
+            inner = keyed.get("backend", keyed)
+            if inner.get("kind") == "changelog":
+                # states created after the last materialization exist only
+                # in the log — union those names in
+                names.update(rec[1] for rec in inner.get("log", ()))
+                inner = inner.get("mat") or {}
+            names.update(inner.get("states", {}))
+        return sorted(names)
+
+    def _op_snapshots(self, vertex: str, op_key: str) -> Iterator[dict]:
+        for tid, snap in self.checkpoint.task_snapshots.items():
+            if tid.rsplit("#", 1)[0] != vertex:
+                continue
+            op = (snap.get("chain") or {}).get(op_key)
+            if op:
+                yield op
+
+    # -- reads -------------------------------------------------------------
+    def keyed_state(self, vertex: str, op_key: str,
+                    state_name: str) -> list[KeyedStateRecord]:
+        """All (key, namespace, value) entries of one state across
+        subtasks (reference readKeyedState)."""
+        out: list[KeyedStateRecord] = []
+        for op in self._op_snapshots(vertex, op_key):
+            if op.get("keyed"):
+                out.extend(_iter_heap_states(op["keyed"], state_name))
+        return out
+
+    def operator_state(self, vertex: str, op_key: str,
+                       list_name: str) -> list:
+        """Union of one operator-list state across subtasks
+        (reference readListState)."""
+        out: list = []
+        for op in self._op_snapshots(vertex, op_key):
+            lists = (op.get("operator") or {}).get("lists", {})
+            out.extend(lists.get(list_name, []))
+        return out
+
+    def reader_state(self, vertex: str) -> dict[int, Any]:
+        """Source reader positions per subtask."""
+        out: dict[int, Any] = {}
+        for tid, snap in self.checkpoint.task_snapshots.items():
+            vid, sub = tid.rsplit("#", 1)
+            if vid == vertex and snap.get("reader") is not None:
+                out[int(sub)] = snap["reader"]
+        return out
+
+
+class SavepointWriter:
+    """Create or transform savepoints offline (reference SavepointWriter:
+    from_existing + bootstrap/patch/remove, then write)."""
+
+    def __init__(self, base: Optional[CompletedCheckpoint] = None,
+                 max_parallelism: int = 128):
+        self.max_parallelism = max_parallelism
+        self._snapshots: dict[str, dict] = (
+            {tid: _deep_copy(snap)
+             for tid, snap in base.task_snapshots.items()}
+            if base is not None else {})
+        self._vertex_parallelism: dict[str, int] = (
+            dict(base.vertex_parallelism) if base is not None else {})
+        self._vertex_uids: dict[str, str] = (
+            dict(base.vertex_uids) if base is not None else {})
+
+    @staticmethod
+    def from_existing(path: str) -> "SavepointWriter":
+        return SavepointWriter(SavepointReader.read(path).checkpoint)
+
+    # -- transforms --------------------------------------------------------
+    def remove_operator(self, vertex: str, op_key: str) -> "SavepointWriter":
+        for tid, snap in self._snapshots.items():
+            if tid.rsplit("#", 1)[0] == vertex:
+                (snap.get("chain") or {}).pop(op_key, None)
+        return self
+
+    def with_keyed_state(self, vertex: str, op_key: str, state_name: str,
+                         records: Iterable, parallelism: int = 1,
+                         ) -> "SavepointWriter":
+        """Bootstrap/overwrite one keyed state from (key, value) or
+        (key, namespace, value) records, laid out per key group exactly as
+        the heap backend snapshots it."""
+        per_sub_states: list[dict] = [
+            {} for _ in range(parallelism)]
+        from ..core.keygroups import operator_index_for_key_group
+        for rec in records:
+            if len(rec) == 2:
+                key, value = rec
+                ns = None
+            else:
+                key, ns, value = rec
+            kg = assign_to_key_group(key, self.max_parallelism)
+            sub = operator_index_for_key_group(self.max_parallelism,
+                                               parallelism, kg)
+            per_kg = per_sub_states[sub].setdefault(kg, [])
+            per_kg.append(((key, ns), value, None))
+
+        self._vertex_parallelism[vertex] = parallelism
+        # drop stale subtasks beyond the new parallelism: restore unions
+        # keyed state across ALL task snapshots, so leftovers would
+        # resurrect pre-bootstrap values
+        for tid in list(self._snapshots):
+            vid, sub = tid.rsplit("#", 1)
+            if vid == vertex and int(sub) >= parallelism:
+                del self._snapshots[tid]
+        for sub in range(parallelism):
+            tid = f"{vertex}#{sub}"
+            snap = self._snapshots.setdefault(tid, {})
+            chain = snap.setdefault("chain", {})
+            op = chain.setdefault(op_key, {})
+            keyed = op.setdefault("keyed", {"backend": {"kind": "heap",
+                                                        "states": {}},
+                                            "timers": {}})
+            keyed.setdefault("timers", {})  # keyed operators expect the key
+            inner = keyed.setdefault("backend", {"kind": "heap",
+                                                 "states": {}})
+            inner.setdefault("states", {})[state_name] = per_sub_states[sub]
+        return self
+
+    def transform_keyed_state(self, vertex: str, op_key: str,
+                              state_name: str,
+                              fn: Callable[[Any, Any, Any], Optional[Any]]
+                              ) -> "SavepointWriter":
+        """Apply fn(key, namespace, value) -> new value (None deletes) to
+        every entry of one state in place."""
+        for tid, snap in self._snapshots.items():
+            if tid.rsplit("#", 1)[0] != vertex:
+                continue
+            op = (snap.get("chain") or {}).get(op_key) or {}
+            keyed = op.get("keyed") or {}
+            inner = keyed.get("backend", keyed)
+            if inner.get("kind") == "changelog":
+                raise NotImplementedError(
+                    "transforming changelog-backend state requires "
+                    "materialization first (read + with_keyed_state)")
+            per_kg = inner.get("states", {}).get(state_name)
+            if not per_kg:
+                continue
+            for kg, items in per_kg.items():
+                new_items = []
+                for kn, value, expiry in items:
+                    key, ns = tuple(kn) if isinstance(kn, list) else kn
+                    nv = fn(key, ns, value)
+                    if nv is not None:
+                        new_items.append(((key, ns), nv, expiry))
+                per_kg[kg] = new_items
+        return self
+
+    # -- output ------------------------------------------------------------
+    def with_uid(self, vertex: str, uid: str) -> "SavepointWriter":
+        """Stable operator uid for restore into resubmitted programs."""
+        self._vertex_uids[vertex] = uid
+        return self
+
+    def write(self, directory: str,
+              savepoint_id: int = 1) -> CompletedCheckpoint:
+        cp = CompletedCheckpoint(
+            checkpoint_id=savepoint_id, timestamp=time.time(),
+            task_snapshots=self._snapshots, is_savepoint=True,
+            vertex_parallelism=dict(self._vertex_parallelism),
+            vertex_uids=dict(self._vertex_uids))
+        return FsCheckpointStorage(directory).store(cp)
+
+
+def _deep_copy(obj):
+    import copy
+    return copy.deepcopy(obj)
