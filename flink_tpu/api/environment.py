@@ -27,17 +27,44 @@ __all__ = ["StreamExecutionEnvironment"]
 
 
 class StreamExecutionEnvironment:
+    _default: Optional["StreamExecutionEnvironment"] = None
+
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
         self._transformations: list[Transformation] = []
         self._sinks: list[Transformation] = []
         self.last_job = None
+        self._restore_path: Optional[str] = None
 
     @staticmethod
     def get_execution_environment(
             config: Optional[Configuration] = None
     ) -> "StreamExecutionEnvironment":
         return StreamExecutionEnvironment(config)
+
+    @classmethod
+    def get_default(cls) -> "StreamExecutionEnvironment":
+        """Process-default environment (the reference's context environment):
+        the CLI pre-configures it, user scripts pick it up."""
+        if cls._default is None:
+            cls._default = StreamExecutionEnvironment()
+        return cls._default
+
+    def restore_from_savepoint(self, path: str
+                               ) -> "StreamExecutionEnvironment":
+        """The next execute()/execute_async() starts from this savepoint
+        (reference 'flink run -s <path>'). Operators map by stable uid, so
+        the pipeline may be a resubmitted build of the program."""
+        self._restore_path = path
+        return self
+
+    def _take_restore_checkpoint(self):
+        """Consume the pending restore path -> CompletedCheckpoint."""
+        if not self._restore_path:
+            return None
+        from ..checkpoint.storage import FsCheckpointStorage
+        path, self._restore_path = self._restore_path, None
+        return FsCheckpointStorage(".").load(path)
 
     # -- config sugar ------------------------------------------------------
     @property
@@ -127,16 +154,22 @@ class StreamExecutionEnvironment:
         runs under a JobSupervisor that restarts from the latest completed
         checkpoint on task failure (requires enable_checkpointing)."""
         jg = self.get_job_graph(job_name)
+        cp = self._take_restore_checkpoint()
         if recover:
             from ..cluster.scheduler import JobSupervisor
             supervisor = JobSupervisor(jg, self.config,
                                        metrics_registry=metrics_registry)
-            self.last_job = supervisor.run(timeout)
+            self.last_job = supervisor.run(timeout, initial_restore=cp)
             self.last_job.supervisor = supervisor
         else:
             from ..cluster.local import run_job
+            restored_state = None
+            if cp is not None:
+                from ..checkpoint.coordinator import build_restore_map
+                restored_state = build_restore_map(cp, jg)
             self.last_job = run_job(jg, self.config, timeout=timeout,
-                                    metrics_registry=metrics_registry)
+                                    metrics_registry=metrics_registry,
+                                    restored_state=restored_state)
         # a fresh env per execute is the common pattern; clear so the same
         # env can be reused for a new pipeline
         self._transformations = []
@@ -147,7 +180,13 @@ class StreamExecutionEnvironment:
                       metrics_registry=None):
         from ..cluster.local import deploy_local
         jg = self.get_job_graph(job_name)
-        job = deploy_local(jg, self.config, metrics_registry=metrics_registry)
+        cp = self._take_restore_checkpoint()
+        restored_state = None
+        if cp is not None:
+            from ..checkpoint.coordinator import build_restore_map
+            restored_state = build_restore_map(cp, jg)
+        job = deploy_local(jg, self.config, restored_state=restored_state,
+                           metrics_registry=metrics_registry)
         job.start()
         self.last_job = job
         self._transformations = []

@@ -51,11 +51,14 @@ class _Pending:
 
 class CheckpointCoordinator:
     def __init__(self, job, config: Configuration,
-                 storage: Optional[CheckpointStorage] = None):
+                 storage: Optional[CheckpointStorage] = None, tracer=None):
         """``job`` is a LocalJob-like object exposing .tasks, .source_tasks,
-        and a checkpoint_listener hook."""
+        and a checkpoint_listener hook. ``tracer`` (metrics/tracing.Tracer)
+        receives a span per completed checkpoint, like the reference's
+        CheckpointStatsTracker span emission."""
         self.job = job
         self.config = config
+        self.tracer = tracer
         directory = config.get(CheckpointingOptions.DIRECTORY)
         self.storage = storage or (FsCheckpointStorage(directory) if directory
                                    else MemoryCheckpointStorage())
@@ -135,6 +138,13 @@ class CheckpointCoordinator:
             vertex_parallelism=vertex_par, vertex_uids=vertex_uids)
         cp = self.storage.store(cp)
         duration = time.time() - p.started
+        if self.tracer is not None:
+            (self.tracer.span("checkpoint-coordinator", "Checkpoint")
+             .set_start_ts(int(p.started * 1000))
+             .set_attribute("checkpointId", p.checkpoint_id)
+             .set_attribute("savepoint", p.is_savepoint)
+             .set_attribute("tasks", len(p.acks))
+             .finish(int(time.time() * 1000)))
         with self._lock:
             # keep the store ordered by checkpoint id, not completion time:
             # with max-concurrent > 1 a slow older checkpoint may complete

@@ -1,0 +1,98 @@
+"""CLI: run pipelines and inspect savepoints from the command line.
+
+Analog of the reference CliFrontend (flink-clients CliFrontend.java:92):
+
+    python -m flink_tpu.cli run <script.py> [--parallelism N]
+                                            [--state-backend NAME]
+                                            [--checkpoint-dir DIR]
+                                            [--checkpoint-interval SECS]
+                                            [--from-savepoint PATH]
+    python -m flink_tpu.cli savepoint-info <path>
+    python -m flink_tpu.cli version
+
+``run`` executes a user script that builds a pipeline on
+StreamExecutionEnvironment.get_default() — the CLI pre-configures that
+environment from the flags (parallelism, backend, checkpointing, savepoint
+restore), mirroring how the reference CLI injects configuration into the
+user program's environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def _cmd_run(args) -> int:
+    from .api.environment import StreamExecutionEnvironment
+    from .core.config import (
+        CheckpointingOptions, PipelineOptions, StateOptions,
+    )
+
+    env = StreamExecutionEnvironment.get_default()
+    if args.parallelism:
+        env.set_parallelism(args.parallelism)
+    if args.state_backend:
+        env.config.set(StateOptions.BACKEND, args.state_backend)
+    if args.checkpoint_dir:
+        env.config.set(CheckpointingOptions.DIRECTORY, args.checkpoint_dir)
+    if args.checkpoint_interval:
+        env.config.set(CheckpointingOptions.INTERVAL,
+                       args.checkpoint_interval)
+    if args.from_savepoint:
+        env.restore_from_savepoint(args.from_savepoint)
+    try:
+        runpy.run_path(args.script, run_name="__main__")
+    except SystemExit as e:
+        return int(e.code or 0)
+    return 0
+
+
+def _cmd_savepoint_info(args) -> int:
+    from .state_processor import SavepointReader
+
+    reader = SavepointReader.read(args.path)
+    cp = reader.checkpoint
+    print(f"savepoint id={cp.checkpoint_id} "
+          f"savepoint={cp.is_savepoint} path={cp.external_path}")
+    for vertex in reader.vertices():
+        par = cp.vertex_parallelism.get(vertex, "?")
+        uid = (cp.vertex_uids or {}).get(vertex, "")
+        print(f"  vertex {vertex} parallelism={par} uid={uid}")
+        for op_key in reader.operators(vertex).get(vertex, []):
+            names = reader.state_names(vertex, op_key)
+            print(f"    operator {op_key!r} keyed-states={names}")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="flink-tpu", description="flink-tpu command line client")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run a pipeline script")
+    run.add_argument("script")
+    run.add_argument("--parallelism", "-p", type=int, default=0)
+    run.add_argument("--state-backend", default="")
+    run.add_argument("--checkpoint-dir", default="")
+    run.add_argument("--checkpoint-interval", type=float, default=0.0)
+    run.add_argument("--from-savepoint", default="")
+    run.set_defaults(fn=_cmd_run)
+
+    spi = sub.add_parser("savepoint-info", help="inspect a savepoint")
+    spi.add_argument("path")
+    spi.set_defaults(fn=_cmd_savepoint_info)
+
+    ver = sub.add_parser("version", help="print version")
+    ver.set_defaults(fn=lambda a: (print("flink-tpu 0.1"), 0)[1])
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

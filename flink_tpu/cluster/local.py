@@ -235,9 +235,11 @@ def _make_reader(src_node, subtask: int, parallelism: int):
 
 def run_job(job_graph: JobGraph, config: Configuration,
             timeout: Optional[float] = 120.0,
-            metrics_registry=None) -> LocalJob:
+            metrics_registry=None,
+            restored_state: Optional[dict] = None) -> LocalJob:
     """Deploy, optionally attach periodic checkpointing, run to completion."""
-    job = deploy_local(job_graph, config, metrics_registry=metrics_registry)
+    job = deploy_local(job_graph, config, restored_state=restored_state,
+                       metrics_registry=metrics_registry)
     coordinator = None
     interval = config.get(CheckpointingOptions.INTERVAL)
     if interval and interval > 0:

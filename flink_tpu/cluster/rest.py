@@ -1,0 +1,154 @@
+"""REST status endpoint for a running local job.
+
+Analog of the reference's web monitor / REST API (flink-runtime
+rest/RestServerEndpoint.java:86, WebMonitorEndpoint.java:194, handlers under
+rest/handler/job/ incl. savepoint triggering SavepointHandlers.java:115),
+reduced to the operationally useful slice:
+
+    GET  /jobs                    -> running job overview
+    GET  /jobs/<name>             -> vertices, parallelism, task states
+    GET  /jobs/<name>/checkpoints -> completed checkpoint stats
+    POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
+    GET  /metrics                 -> prometheus text exposition
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import socketserver
+import threading
+from typing import Any, Optional
+
+__all__ = ["RestEndpoint"]
+
+
+class RestEndpoint:
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 metrics_registry=None):
+        self._host = host
+        self._requested_port = port
+        self._jobs: dict[str, Any] = {}          # name -> LocalJob
+        self._coordinators: dict[str, Any] = {}  # name -> coordinator
+        self.metrics_registry = metrics_registry
+        self._httpd: Optional[socketserver.TCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    # -- registration ------------------------------------------------------
+    def register_job(self, name: str, job, coordinator=None) -> None:
+        self._jobs[name] = job
+        if coordinator is not None:
+            self._coordinators[name] = coordinator
+
+    # -- views -------------------------------------------------------------
+    def _job_overview(self) -> list[dict]:
+        out = []
+        for name, job in self._jobs.items():
+            running = sum(1 for t in job.tasks.values() if t.is_alive)
+            out.append({"name": name,
+                        "state": ("FAILED" if job.failed
+                                  else "RUNNING" if running else "FINISHED"),
+                        "tasks": len(job.tasks), "running_tasks": running})
+        return out
+
+    def _job_detail(self, name: str) -> Optional[dict]:
+        job = self._jobs.get(name)
+        if job is None:
+            return None
+        vertices = []
+        for vid, v in job.job_graph.vertices.items():
+            subtasks = []
+            for sub in range(v.parallelism):
+                t = job.tasks.get(f"{vid}#{sub}")
+                subtasks.append({
+                    "subtask": sub,
+                    "state": "RUNNING" if (t and t.is_alive) else "FINISHED"})
+            vertices.append({"id": vid, "name": v.name, "uid": v.uid,
+                             "parallelism": v.parallelism,
+                             "subtasks": subtasks})
+        return {"name": name, "vertices": vertices}
+
+    def _checkpoints(self, name: str) -> Optional[list]:
+        coord = self._coordinators.get(name)
+        if coord is None:
+            return []
+        return [{"id": c.checkpoint_id, "savepoint": c.is_savepoint,
+                 "external_path": c.external_path}
+                for c in getattr(coord, "_completed", [])]
+
+    def _trigger_savepoint(self, name: str) -> dict:
+        coord = self._coordinators.get(name)
+        if coord is None:
+            return {"error": "job has no checkpoint coordinator"}
+        sp = coord.trigger_savepoint(timeout=60)
+        return {"id": sp.checkpoint_id, "external_path": sp.external_path}
+
+    # -- server ------------------------------------------------------------
+    def start(self) -> int:
+        endpoint = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["jobs"]:
+                    self._reply(200, endpoint._job_overview())
+                elif len(parts) == 2 and parts[0] == "jobs":
+                    detail = endpoint._job_detail(parts[1])
+                    self._reply(200 if detail else 404,
+                                detail or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "checkpoints"):
+                    self._reply(200, endpoint._checkpoints(parts[1]))
+                elif parts == ["metrics"]:
+                    from ..metrics.reporters import prometheus_text
+                    reg = endpoint.metrics_registry
+                    text = prometheus_text(reg) if reg else ""
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                if (len(parts) == 3 and parts[0] == "jobs"
+                        and parts[2] == "savepoints"):
+                    try:
+                        self._reply(200,
+                                    endpoint._trigger_savepoint(parts[1]))
+                    except Exception as e:  # noqa: BLE001 - return to client
+                        self._reply(500, {"error": repr(e)})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def log_message(self, *args):
+                pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = _Server((self._host, self._requested_port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="rest-endpoint", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

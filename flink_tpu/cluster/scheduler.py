@@ -62,11 +62,16 @@ class JobSupervisor:
         self.coordinator = coordinator
         return job
 
-    def run(self, timeout: Optional[float] = 300.0) -> LocalJob:
+    def run(self, timeout: Optional[float] = 300.0,
+            initial_restore: Optional[CompletedCheckpoint] = None
+            ) -> LocalJob:
         """Blocking execute-with-recovery; raises when the restart strategy
-        gives up or the deadline passes."""
+        gives up or the deadline passes. ``initial_restore`` starts the
+        first attempt from a savepoint/checkpoint (reference 'run -s')."""
         deadline = None if timeout is None else time.time() + timeout
-        restore = None
+        restore = initial_restore
+        if initial_restore is not None:
+            self._latest = initial_restore
         while True:
             self.attempt += 1
             job = self._deploy(restore)

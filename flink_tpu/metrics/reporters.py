@@ -1,0 +1,145 @@
+"""Metric reporters: push/pull exporters over the registry.
+
+Analog of the reference's reporter stack (flink-metrics: MetricReporter SPI
+loaded via ReporterSetup.java:64; flink-metrics-prometheus
+PrometheusReporter exposing the registry over HTTP in the Prometheus text
+format; flink-metrics-slf4j periodic logging reporter).
+"""
+
+from __future__ import annotations
+
+import http.server
+import re
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .core import Counter, Gauge, Histogram, Meter, MetricRegistry
+
+__all__ = ["MetricReporter", "PrometheusReporter", "LoggingReporter",
+           "prometheus_text"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(parts: tuple[str, ...]) -> str:
+    return _NAME_RE.sub("_", "_".join(("flink_tpu",) + parts))
+
+
+def prometheus_text(registry: MetricRegistry) -> str:
+    """Render the registry in the Prometheus text exposition format
+    (reference PrometheusReporter's collector mapping: Counter->counter,
+    Gauge->gauge, Meter->gauge(rate)+counter, Histogram->summary)."""
+    lines: list[str] = []
+    for scope, m in sorted(registry.all_metrics().items()):
+        name = _prom_name(scope)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {m.count}")
+        elif isinstance(m, Gauge):
+            try:
+                v = m.value
+            except Exception:  # noqa: BLE001 - gauge fn may race shutdown
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v}")
+        elif isinstance(m, Meter):
+            lines.append(f"# TYPE {name}_rate gauge")
+            lines.append(f"{name}_rate {m.rate}")
+            lines.append(f"# TYPE {name}_total counter")
+            lines.append(f"{name}_total {m.count}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {name} summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(
+                    f'{name}{{quantile="{q}"}} {m.quantile(q)}')
+            lines.append(f"{name}_count {m.count}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricReporter:
+    """Reporter SPI (reference MetricReporter + Scheduled)."""
+
+    def open(self, registry: MetricRegistry) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class PrometheusReporter(MetricReporter):
+    """Serves GET /metrics in the text exposition format (pull model)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._requested_port = port
+        self._host = host
+        self._httpd: Optional[socketserver.TCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def open(self, registry: MetricRegistry) -> None:
+        reporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = prometheus_text(registry).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence request logging
+                pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = _Server((self._host, self._requested_port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="prometheus-reporter",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class LoggingReporter(MetricReporter):
+    """Periodic snapshot dump (reference Slf4jReporter); ``sink`` defaults
+    to print, injectable for tests."""
+
+    def __init__(self, interval_s: float = 10.0,
+                 sink: Optional[Callable[[str], None]] = None):
+        self._interval = interval_s
+        self._sink = sink or (lambda line: print(line))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def open(self, registry: MetricRegistry) -> None:
+        def loop():
+            while not self._stop.wait(self._interval):
+                snap = registry.snapshot()
+                for name in sorted(snap):
+                    self._sink(f"{name}={snap[name]}")
+
+        self._thread = threading.Thread(target=loop,
+                                        name="logging-reporter", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,106 @@
+"""Tracing: spans for checkpoint/recovery/job phases.
+
+Analog of the reference's 1.19 trace API (flink-metrics-core
+traces/{Span.java, SpanBuilder.java:27, reporter/TraceReporter.java:31},
+wired by TraceReporterSetup.java:63; checkpoint/recovery durations emitted
+from CheckpointStatsTracker.java:267). Spans are scoped named durations with
+attributes; reporters receive completed spans.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+__all__ = ["Span", "SpanBuilder", "TraceReporter", "InMemoryTraceReporter",
+           "Tracer"]
+
+
+@dataclass(frozen=True)
+class Span:
+    scope: str
+    name: str
+    start_ms: int
+    end_ms: int
+    attributes: dict = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> int:
+        return self.end_ms - self.start_ms
+
+
+class SpanBuilder:
+    """Fluent builder (reference SpanBuilder)."""
+
+    def __init__(self, tracer: "Tracer", scope: str, name: str):
+        self._tracer = tracer
+        self._scope = scope
+        self._name = name
+        self._start_ms = int(time.time() * 1000)
+        self._attrs: dict = {}
+
+    def set_attribute(self, key: str, value: Any) -> "SpanBuilder":
+        self._attrs[key] = value
+        return self
+
+    def set_start_ts(self, start_ms: int) -> "SpanBuilder":
+        self._start_ms = int(start_ms)
+        return self
+
+    def finish(self, end_ms: Optional[int] = None) -> Span:
+        span = Span(self._scope, self._name, self._start_ms,
+                    int(time.time() * 1000) if end_ms is None else end_ms,
+                    dict(self._attrs))
+        self._tracer._report(span)
+        return span
+
+    def __enter__(self) -> "SpanBuilder":
+        self._start_ms = int(time.time() * 1000)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.set_attribute("error", exc_type is not None)
+        self.finish()
+
+
+class TraceReporter:
+    """Receives completed spans (reference TraceReporter.addSpan)."""
+
+    def add_span(self, span: Span) -> None:
+        raise NotImplementedError
+
+
+class InMemoryTraceReporter(TraceReporter):
+    def __init__(self):
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def add_span(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+    def by_name(self, name: str) -> list[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.name == name]
+
+
+class Tracer:
+    """Span factory + reporter fan-out (reference TraceReporterSetup)."""
+
+    def __init__(self, reporters: Optional[list[TraceReporter]] = None):
+        self._reporters = list(reporters or [])
+
+    def add_reporter(self, reporter: TraceReporter) -> None:
+        self._reporters.append(reporter)
+
+    def span(self, scope: str, name: str) -> SpanBuilder:
+        return SpanBuilder(self, scope, name)
+
+    def _report(self, span: Span) -> None:
+        for r in self._reporters:
+            try:
+                r.add_span(span)
+            except Exception:  # noqa: BLE001 - reporters must not kill jobs
+                pass

@@ -1,0 +1,195 @@
+"""Observability: prometheus reporter, spans, REST endpoint, CLI
+(reference test models: PrometheusReporterTest, rest handler ITCases)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.core.config import CheckpointingOptions, PipelineOptions
+from flink_tpu.core.records import Schema
+from flink_tpu.metrics.core import MetricRegistry
+from flink_tpu.metrics.reporters import (
+    LoggingReporter, PrometheusReporter, prometheus_text,
+)
+from flink_tpu.metrics.tracing import InMemoryTraceReporter, Tracer
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_prometheus_text_rendering():
+    reg = MetricRegistry()
+    g = reg.root().group("job").group("task")
+    g.counter("numRecordsIn").inc(42)
+    g.gauge("lag", lambda: 7.5)
+    g.histogram("latency").update(10)
+    text = prometheus_text(reg)
+    assert "flink_tpu_job_task_numRecordsIn 42" in text
+    assert "flink_tpu_job_task_lag 7.5" in text
+    assert 'quantile="0.99"' in text
+    assert "# TYPE flink_tpu_job_task_numRecordsIn counter" in text
+
+
+def test_prometheus_reporter_serves_http():
+    reg = MetricRegistry()
+    reg.root().group("up").counter("c").inc(3)
+    rep = PrometheusReporter(port=0)
+    rep.open(reg)
+    try:
+        status, body = _get(f"http://127.0.0.1:{rep.port}/metrics")
+        assert status == 200
+        assert "flink_tpu_up_c 3" in body
+        status, _ = _get(f"http://127.0.0.1:{rep.port}/metrics")
+        assert status == 200
+    finally:
+        rep.close()
+
+
+def test_logging_reporter():
+    reg = MetricRegistry()
+    reg.root().counter("x").inc(1)
+    lines = []
+    rep = LoggingReporter(interval_s=0.02, sink=lines.append)
+    rep.open(reg)
+    time.sleep(0.1)
+    rep.close()
+    assert any("x=1" in ln for ln in lines)
+
+
+def test_tracer_spans():
+    mem = InMemoryTraceReporter()
+    tracer = Tracer([mem])
+    with tracer.span("test", "Work") as sb:
+        sb.set_attribute("n", 5)
+        time.sleep(0.01)
+    spans = mem.by_name("Work")
+    assert len(spans) == 1
+    assert spans[0].duration_ms >= 10
+    assert spans[0].attributes["n"] == 5
+    assert spans[0].attributes["error"] is False
+
+
+def test_checkpoint_spans_emitted():
+    from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.config.set(PipelineOptions.BATCH_SIZE, 8)
+    n = 2000
+    rows = [(i % 3, i) for i in range(n)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(n)))
+    from flink_tpu.connectors.core import CollectSink
+    ds.key_by("k").sum(1).add_sink(CollectSink(), "s")
+    job = env.execute_async("spans")
+    mem = InMemoryTraceReporter()
+    coord = CheckpointCoordinator(job, env.config, tracer=Tracer([mem]))
+    for _ in range(50):
+        try:
+            coord.trigger_savepoint(timeout=2)
+            break
+        except Exception:
+            time.sleep(0.02)
+    job.wait(30)
+    spans = mem.by_name("Checkpoint")
+    assert spans and spans[0].attributes["savepoint"] is True
+
+
+def test_rest_endpoint():
+    from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+    from flink_tpu.cluster.rest import RestEndpoint
+    from flink_tpu.metrics.core import MetricRegistry
+
+    reg = MetricRegistry()
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.config.set(PipelineOptions.BATCH_SIZE, 4)
+    n = 4000
+    rows = [(i % 3, i) for i in range(n)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(n)))
+    from flink_tpu.connectors.core import CollectSink
+    ds.key_by("k").sum(1).add_sink(CollectSink(), "s")
+    job = env.execute_async("rest-job", metrics_registry=reg)
+    coord = CheckpointCoordinator(job, env.config)
+    endpoint = RestEndpoint(port=0, metrics_registry=reg)
+    endpoint.register_job("rest-job", job, coord)
+    port = endpoint.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        status, body = _get(f"{base}/jobs")
+        jobs = json.loads(body)
+        assert status == 200 and jobs[0]["name"] == "rest-job"
+        assert jobs[0]["state"] in ("RUNNING", "FINISHED")
+
+        status, body = _get(f"{base}/jobs/rest-job")
+        detail = json.loads(body)
+        assert status == 200
+        assert any("KeyedSum" in v["name"] or "Sum" in v["name"]
+                   or v["subtasks"] for v in detail["vertices"])
+
+        # trigger a savepoint over REST while the job runs
+        req = urllib.request.Request(f"{base}/jobs/rest-job/savepoints",
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            sp = json.loads(r.read().decode())
+        assert "id" in sp
+
+        status, body = _get(f"{base}/jobs/rest-job/checkpoints")
+        cps = json.loads(body)
+        assert any(c["savepoint"] for c in cps)
+
+        status, body = _get(f"{base}/metrics")
+        assert status == 200 and "flink_tpu" in body
+
+        status, _ = _get(f"{base}/jobs/nope")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        endpoint.stop()
+        job.wait(60)
+
+
+def test_cli_savepoint_info_and_version(tmp_path, capsys):
+    from flink_tpu.cli import main
+    from flink_tpu.state_processor import SavepointWriter
+
+    assert main(["version"]) == 0
+    sp = (SavepointWriter(max_parallelism=128)
+          .with_keyed_state("v1", "0:KeyedProcess", "cnt",
+                            [(1, 10)], parallelism=1)
+          .write(str(tmp_path)))
+    assert main(["savepoint-info", sp.external_path]) == 0
+    out = capsys.readouterr().out
+    assert "v1" in out and "cnt" in out
+
+
+def test_cli_run_with_savepoint(tmp_path):
+    """CLI run: pre-configured default env + restore from savepoint."""
+    from flink_tpu.cli import main
+
+    script = tmp_path / "pipeline.py"
+    script.write_text(
+        "import numpy as np\n"
+        "from flink_tpu.api.environment import StreamExecutionEnvironment\n"
+        "from flink_tpu.core.records import Schema\n"
+        "from flink_tpu.connectors.core import CollectSink\n"
+        "env = StreamExecutionEnvironment.get_default()\n"
+        "schema = Schema([('k', np.int64), ('v', np.int64)])\n"
+        "rows = [(i % 2, i) for i in range(10)]\n"
+        "ds = env.from_collection(rows, schema, "
+        "timestamps=list(range(10)))\n"
+        "sink = CollectSink()\n"
+        "ds.key_by('k').sum(1).add_sink(sink, 's')\n"
+        "env.execute('cli-job')\n"
+        f"open(r'{tmp_path}/done', 'w').write(str(len(sink.rows)))\n")
+    rc = main(["run", str(script), "--parallelism", "2"])
+    assert rc == 0
+    assert (tmp_path / "done").read_text() == "10"
+    # the CLI configured the default env's parallelism
+    assert StreamExecutionEnvironment.get_default().parallelism == 2
