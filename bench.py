@@ -26,8 +26,23 @@ RING = 8
 BATCH = 1 << 17
 N_BATCHES = 8               # distinct pre-generated batches, cycled
 WARMUP = 3
-TIMED = 24
+WINDOW_ITERS = 8            # steps per timed window
+N_WINDOWS = 6               # report the median window (the chip sits
+                            # behind a shared tunnel; medians shrug off
+                            # contention spikes that a single window can't)
 HOST_EVENTS = 400_000
+
+
+def _median_window_eps(run_window) -> float:
+    """Run N_WINDOWS timed windows; each returns events/sec; report the
+    median."""
+    rates = []
+    for w in range(N_WINDOWS):
+        rates.append(run_window(w))
+    rates.sort()
+    mid = len(rates) // 2
+    return (rates[mid] if len(rates) % 2
+            else 0.5 * (rates[mid - 1] + rates[mid]))
 
 
 def bench_device() -> float:
@@ -69,20 +84,84 @@ def bench_device() -> float:
     sum_acc = jax.device_put(
         make_accumulator("sum", (RING, CAPACITY), jnp.float32), dev)
 
+    state = [table, count_acc, sum_acc]
     for i in range(WARMUP):
         j = i % N_BATCHES
-        table, count_acc, sum_acc = step(table, count_acc, sum_acc,
-                                         keys[j], vals[j], panes[j])
-    jax.block_until_ready(table)
+        state = list(step(*state, keys[j], vals[j], panes[j]))
+    jax.block_until_ready(state[0])
 
-    t0 = time.perf_counter()
-    for i in range(TIMED):
+    def window(w: int) -> float:
+        t0 = time.perf_counter()
+        for i in range(WINDOW_ITERS):
+            j = (w * WINDOW_ITERS + i) % N_BATCHES
+            state[:] = step(*state, keys[j], vals[j], panes[j])
+        jax.block_until_ready(tuple(state))
+        return WINDOW_ITERS * BATCH / (time.perf_counter() - t0)
+
+    return _median_window_eps(window)
+
+
+def bench_device_q7() -> float:
+    """Nexmark Q7: highest bid (price + argmax payload) per window pane.
+    Device shape: scatter-max of price into per-pane slots plus a second
+    scatter that captures the winning bid's payload via price-ordered
+    max of a packed (price << 20 | bidder) word — one fused XLA program."""
+    import jax
+    import jax.numpy as jnp
+    from flink_tpu.ops.hash_table import ensure_x64
+
+    ensure_x64()
+
+    @jax.jit
+    def step(pane_max, pane_packed, prices, bidders, panes):
+        # max price per pane
+        pane_max = pane_max.at[panes].max(prices)
+        # packed word keeps the argmax payload attached to the price order
+        packed = (prices.astype(jnp.int64) << 20) | bidders
+        pane_packed = pane_packed.at[panes].max(packed)
+        return pane_max, pane_packed
+
+    rng = np.random.default_rng(7)
+    prices_h = rng.integers(0, 1 << 40, (N_BATCHES, BATCH)).astype(np.int64)
+    bidders_h = rng.integers(0, 1 << 20, (N_BATCHES, BATCH)).astype(np.int64)
+    panes_h = rng.integers(0, RING, (N_BATCHES, BATCH)).astype(np.int64)
+    dev = jax.devices()[0]
+    prices = [jax.device_put(jnp.asarray(p), dev) for p in prices_h]
+    bidders = [jax.device_put(jnp.asarray(b), dev) for b in bidders_h]
+    panes = [jax.device_put(jnp.asarray(p), dev) for p in panes_h]
+    pane_max = jnp.zeros(RING, jnp.int64)
+    pane_packed = jnp.zeros(RING, jnp.int64)
+
+    state = [pane_max, pane_packed]
+    for i in range(WARMUP):
         j = i % N_BATCHES
-        table, count_acc, sum_acc = step(table, count_acc, sum_acc,
-                                         keys[j], vals[j], panes[j])
-    jax.block_until_ready((table, count_acc, sum_acc))
+        state = list(step(*state, prices[j], bidders[j], panes[j]))
+    jax.block_until_ready(state[0])
+
+    def window(w: int) -> float:
+        t0 = time.perf_counter()
+        for i in range(WINDOW_ITERS):
+            j = (w * WINDOW_ITERS + i) % N_BATCHES
+            state[:] = step(*state, prices[j], bidders[j], panes[j])
+        jax.block_until_ready(tuple(state))
+        return WINDOW_ITERS * BATCH / (time.perf_counter() - t0)
+
+    return _median_window_eps(window)
+
+
+def bench_host_q7() -> float:
+    rng = np.random.default_rng(7)
+    prices = rng.integers(0, 1 << 40, HOST_EVENTS).tolist()
+    bidders = rng.integers(0, 1 << 20, HOST_EVENTS).tolist()
+    panes = rng.integers(0, RING, HOST_EVENTS).tolist()
+    best: dict = {}
+    t0 = time.perf_counter()
+    for p, b, w in zip(prices, bidders, panes):
+        cur = best.get(w)
+        if cur is None or p > cur[0]:
+            best[w] = (p, b)
     dt = time.perf_counter() - t0
-    return TIMED * BATCH / dt
+    return HOST_EVENTS / dt
 
 
 def bench_host() -> float:
@@ -115,5 +194,23 @@ def main() -> None:
     }))
 
 
-if __name__ == "__main__":
+def suite() -> None:
+    """Extended matrix (one JSON line per metric) — `python bench.py
+    --suite`. The driver contract stays the single Q5 line in main()."""
     main()
+    q7 = bench_device_q7()
+    q7_host = bench_host_q7()
+    print(json.dumps({
+        "metric": "nexmark_q7_highest_bid_events_per_sec",
+        "value": round(q7, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(q7 / q7_host, 2),
+    }))
+
+
+if __name__ == "__main__":
+    import sys
+    if "--suite" in sys.argv:
+        suite()
+    else:
+        main()

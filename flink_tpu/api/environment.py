@@ -62,9 +62,9 @@ class StreamExecutionEnvironment:
         """Consume the pending restore path -> CompletedCheckpoint."""
         if not self._restore_path:
             return None
-        from ..checkpoint.storage import FsCheckpointStorage
+        from ..state_processor import SavepointReader
         path, self._restore_path = self._restore_path, None
-        return FsCheckpointStorage(".").load(path)
+        return SavepointReader.read(path).checkpoint
 
     # -- config sugar ------------------------------------------------------
     @property

@@ -29,9 +29,7 @@ __all__ = ["main"]
 
 def _cmd_run(args) -> int:
     from .api.environment import StreamExecutionEnvironment
-    from .core.config import (
-        CheckpointingOptions, PipelineOptions, StateOptions,
-    )
+    from .core.config import CheckpointingOptions, StateOptions
 
     env = StreamExecutionEnvironment.get_default()
     if args.parallelism:
@@ -48,7 +46,12 @@ def _cmd_run(args) -> int:
     try:
         runpy.run_path(args.script, run_name="__main__")
     except SystemExit as e:
-        return int(e.code or 0)
+        if e.code is None:
+            return 0
+        if isinstance(e.code, int):
+            return e.code
+        print(e.code, file=sys.stderr)  # sys.exit("message") idiom
+        return 1
     return 0
 
 

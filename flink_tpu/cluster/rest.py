@@ -16,8 +16,6 @@ from __future__ import annotations
 
 import http.server
 import json
-import socketserver
-import threading
 from typing import Any, Optional
 
 __all__ = ["RestEndpoint"]
@@ -25,14 +23,14 @@ __all__ = ["RestEndpoint"]
 
 class RestEndpoint:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 metrics_registry=None):
+                 metrics_registry=None, savepoint_timeout_s: float = 60.0):
         self._host = host
         self._requested_port = port
         self._jobs: dict[str, Any] = {}          # name -> LocalJob
         self._coordinators: dict[str, Any] = {}  # name -> coordinator
         self.metrics_registry = metrics_registry
-        self._httpd: Optional[socketserver.TCPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self.savepoint_timeout_s = savepoint_timeout_s
+        self._server = None
         self.port: Optional[int] = None
 
     # -- registration ------------------------------------------------------
@@ -77,12 +75,19 @@ class RestEndpoint:
                  "external_path": c.external_path}
                 for c in getattr(coord, "_completed", [])]
 
-    def _trigger_savepoint(self, name: str) -> dict:
+    def _trigger_savepoint(self, name: str) -> tuple[int, dict]:
         coord = self._coordinators.get(name)
+        job = self._jobs.get(name)
         if coord is None:
-            return {"error": "job has no checkpoint coordinator"}
-        sp = coord.trigger_savepoint(timeout=60)
-        return {"id": sp.checkpoint_id, "external_path": sp.external_path}
+            return 409, {"error": "job has no checkpoint coordinator"}
+        if job is not None and not any(t.is_alive
+                                       for t in job.tasks.values()):
+            # a barrier into finished tasks can never be acknowledged;
+            # fail fast instead of blocking the handler for the timeout
+            return 409, {"error": "job is not running"}
+        sp = coord.trigger_savepoint(timeout=self.savepoint_timeout_s)
+        return 200, {"id": sp.checkpoint_id,
+                     "external_path": sp.external_path}
 
     # -- server ------------------------------------------------------------
     def start(self) -> int:
@@ -126,8 +131,8 @@ class RestEndpoint:
                 if (len(parts) == 3 and parts[0] == "jobs"
                         and parts[2] == "savepoints"):
                     try:
-                        self._reply(200,
-                                    endpoint._trigger_savepoint(parts[1]))
+                        code, payload = endpoint._trigger_savepoint(parts[1])
+                        self._reply(code, payload)
                     except Exception as e:  # noqa: BLE001 - return to client
                         self._reply(500, {"error": repr(e)})
                 else:
@@ -136,19 +141,13 @@ class RestEndpoint:
             def log_message(self, *args):
                 pass
 
-        class _Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._httpd = _Server((self._host, self._requested_port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="rest-endpoint", daemon=True)
-        self._thread.start()
+        from ..utils.httpd import ThreadedHTTPServer
+        self._server = ThreadedHTTPServer(Handler, self._requested_port,
+                                          self._host, "rest-endpoint")
+        self.port = self._server.start()
         return self.port
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

@@ -75,12 +75,11 @@ class PrometheusReporter(MetricReporter):
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self._requested_port = port
         self._host = host
-        self._httpd: Optional[socketserver.TCPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._server = None
         self.port: Optional[int] = None
 
     def open(self, registry: MetricRegistry) -> None:
-        reporter = self
+        from ..utils.httpd import ThreadedHTTPServer
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - stdlib API
@@ -99,22 +98,14 @@ class PrometheusReporter(MetricReporter):
             def log_message(self, *args):  # silence request logging
                 pass
 
-        class _Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._httpd = _Server((self._host, self._requested_port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="prometheus-reporter",
-                                        daemon=True)
-        self._thread.start()
+        self._server = ThreadedHTTPServer(Handler, self._requested_port,
+                                          self._host, "prometheus-reporter")
+        self.port = self._server.start()
 
     def close(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
 
 
 class LoggingReporter(MetricReporter):

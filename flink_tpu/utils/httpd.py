@@ -1,0 +1,35 @@
+"""Shared threaded-HTTP-server scaffolding for the REST endpoint and the
+Prometheus reporter (one server stack to maintain instead of two)."""
+
+from __future__ import annotations
+
+import http.server
+import socketserver
+import threading
+from typing import Optional, Type
+
+__all__ = ["ThreadedHTTPServer"]
+
+
+class ThreadedHTTPServer:
+    """Ephemeral-port threaded HTTP server with daemon lifecycle."""
+
+    def __init__(self, handler: Type[http.server.BaseHTTPRequestHandler],
+                 port: int = 0, host: str = "127.0.0.1",
+                 name: str = "httpd"):
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = _Server((host, port), handler)
+        self.port: int = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name=name, daemon=True)
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

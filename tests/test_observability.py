@@ -3,6 +3,7 @@
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -147,9 +148,10 @@ def test_rest_endpoint():
         status, body = _get(f"{base}/metrics")
         assert status == 200 and "flink_tpu" in body
 
-        status, _ = _get(f"{base}/jobs/nope")
-    except urllib.error.HTTPError as e:
-        assert e.code == 404
+        # unknown job: narrow 404 probe (must not swallow earlier failures)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"{base}/jobs/nope")
+        assert exc.value.code == 404
     finally:
         endpoint.stop()
         job.wait(60)
