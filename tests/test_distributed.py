@@ -1,0 +1,245 @@
+"""Distributed multi-host runtime: TCP transport, SPMD deployment, control
+plane (reference test models: network stack tests + MiniCluster ITCases,
+here with REAL sockets and separate processes)."""
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cluster.distributed import DistributedHost, subtask_host
+from flink_tpu.cluster.transport import (
+    INITIAL_CREDITS, RemoteChannelSender, TransportServer,
+)
+from flink_tpu.connectors.core import CollectSink
+from flink_tpu.core.config import CheckpointingOptions, PipelineOptions
+from flink_tpu.core.elements import Watermark
+from flink_tpu.core.records import RecordBatch, Schema
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def make_batch(rows):
+    return RecordBatch.from_rows(SCHEMA, rows, list(range(len(rows))))
+
+
+# -- transport -------------------------------------------------------------
+
+def test_transport_roundtrip_batches_and_control():
+    srv = TransportServer()
+    recv = srv.channel("e0:0:0")
+    snd = RemoteChannelSender(srv.host, srv.port, "e0:0:0")
+    b = make_batch([(1, 10), (2, 20)])
+    assert snd.put(b, timeout=5)
+    assert snd.put(Watermark(123), timeout=5)
+    got = _drain(recv, 2)
+    assert isinstance(got[0], RecordBatch) and got[0].n == 2
+    assert list(got[0].column("k")) == [1, 2]
+    assert isinstance(got[1], Watermark) and got[1].timestamp == 123
+    snd.close()
+    srv.close()
+
+
+def _drain(ch, n, timeout=5.0):
+    out = []
+    deadline = time.time() + timeout
+    while len(out) < n and time.time() < deadline:
+        e = ch.poll()
+        if e is None:
+            time.sleep(0.005)
+        else:
+            out.append(e)
+    assert len(out) == n, f"got {len(out)}/{n}"
+    return out
+
+
+def test_transport_credit_backpressure():
+    srv = TransportServer(initial_credits=4)
+    recv = srv.channel("e1:0:0")
+    snd = RemoteChannelSender(srv.host, srv.port, "e1:0:0")
+    b = make_batch([(1, 1)])
+    for _ in range(4):
+        assert snd.put(b, timeout=5)
+    # credits exhausted: the 5th put must block (backpressure)
+    assert snd.put(b, timeout=0.2) is False
+    # consuming one element re-grants one credit
+    _drain(recv, 1)
+    assert snd.put(b, timeout=5)
+    snd.close()
+    srv.close()
+
+
+def test_transport_sender_before_receiver_registration():
+    srv = TransportServer()
+    snd = RemoteChannelSender(srv.host, srv.port, "late:0:0")
+    assert snd.put(make_batch([(9, 9)]), timeout=5)
+    recv = srv.channel("late:0:0")  # registered after data arrived
+    got = _drain(recv, 1)
+    assert got[0].column("k")[0] == 9
+    snd.close()
+    srv.close()
+
+
+# -- in-process two-host job ----------------------------------------------
+
+def build_pipeline(env, sink):
+    n = 200
+    rows = [(i % 10, i) for i in range(n)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(n)))
+    ds.key_by("k").sum(1).add_sink(sink, "sink")
+    return env.get_job_graph("dist-job")
+
+
+def test_two_hosts_in_process():
+    """Two DistributedHosts in one process: real TCP between them, keyed
+    exchange crossing hosts, coordinator control plane."""
+    sinks = [CollectSink(), CollectSink()]
+    graphs = []
+    for h in range(2):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.config.set(PipelineOptions.BATCH_SIZE, 16)
+        graphs.append(build_pipeline(env, sinks[h]))
+    # SPMD invariant: both hosts derive the same topology
+    assert ([v.uid for v in graphs[0].vertices.values()]
+            == [v.uid for v in graphs[1].vertices.values()])
+
+    h0 = DistributedHost(graphs[0], graphs[0].config, 0, 2)
+    h1 = DistributedHost(graphs[1], graphs[1].config, 1, 2,
+                         coordinator_addr=f"127.0.0.1:"
+                         f"{h0.coordinator.port}")
+    peers = {0: h0.data_address, 1: h1.data_address}
+    results = {}
+
+    def run(host, idx):
+        results[idx] = host.run(peers, timeout=60)
+
+    t1 = threading.Thread(target=run, args=(h1, 1), daemon=True)
+    t0 = threading.Thread(target=run, args=(h0, 0), daemon=True)
+    t1.start()
+    t0.start()
+    t0.join(90)
+    t1.join(90)
+    assert not t0.is_alive() and not t1.is_alive()
+    h0.close()
+    h1.close()
+
+    all_rows = sinks[0].rows + sinks[1].rows
+    assert len(all_rows) == 200          # no loss across the wire
+    finals = {}
+    for k, v in all_rows:
+        finals[k] = max(finals.get(k, 0), v)
+    expect = {k: sum(i for i in range(200) if i % 10 == k)
+              for k in range(10)}
+    assert finals == expect
+    # placement really spread subtasks: each host ran a proper subset
+    assert sinks[0].rows and sinks[1].rows
+
+
+WORKER_SCRIPT = r"""
+import pickle, sys, threading
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cluster.distributed import DistributedHost
+from flink_tpu.connectors.core import CollectSink
+from flink_tpu.core.config import CheckpointingOptions, PipelineOptions
+from flink_tpu.core.records import Schema
+
+host_id = int(sys.argv[1])
+out_file = sys.argv[2]
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+env = StreamExecutionEnvironment()
+env.set_parallelism(2)
+env.config.set(PipelineOptions.BATCH_SIZE, 4)
+env.config.set(CheckpointingOptions.INTERVAL, 0.02)
+n = 4000
+rows = [(i % 7, i) for i in range(n)]
+ds = env.from_collection(rows, SCHEMA, timestamps=list(range(n)))
+sink = CollectSink()
+ds.key_by("k").sum(1).add_sink(sink, "sink")
+jg = env.get_job_graph("spmd")
+
+DATA_PORTS = {ports!r}
+COORD_PORT = {coord_port}
+host = DistributedHost(jg, env.config, host_id, 2,
+                       coordinator_addr=None if host_id == 0
+                       else f"127.0.0.1:{{COORD_PORT}}",
+                       data_port=DATA_PORTS[host_id],
+                       coordinator_port=COORD_PORT)
+peers = {{i: ("127.0.0.1", DATA_PORTS[i]) for i in (0, 1)}}
+job = host.run(peers, timeout=120)
+with open(out_file, "wb") as f:
+    pickle.dump({{"rows": sink.rows,
+                  "checkpoints": len(host.coordinator.completed)
+                  if host.coordinator else -1}}, f)
+host.close()
+"""
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_two_processes_spmd():
+    """TRUE multi-process SPMD: two OS processes run the same program,
+    exchange keyed data over TCP, checkpoint via the control plane."""
+    import tempfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tmp = tempfile.mkdtemp()
+    p0, p1, pc = _free_ports(3)
+    script = WORKER_SCRIPT.format(repo=repo, ports={0: p0, 1: p1},
+                                  coord_port=pc)
+    script_path = os.path.join(tmp, "worker.py")
+    with open(script_path, "w") as f:
+        f.write(script)
+    outs = [os.path.join(tmp, f"out-{i}.pkl") for i in (0, 1)]
+    procs = [subprocess.Popen(
+        [sys.executable, script_path, str(i), outs[i]],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for i in (0, 1)]
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed workers timed out")
+        assert p.returncode == 0, err.decode()[-3000:]
+
+    rows = []
+    checkpoints = 0
+    for i, path in enumerate(outs):
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        rows.extend(data["rows"])
+        if i == 0:
+            checkpoints = data["checkpoints"]
+    assert len(rows) == 4000
+    finals = {}
+    for k, v in rows:
+        finals[k] = max(finals.get(k, 0), v)
+    expect = {k: sum(i for i in range(4000) if i % 7 == k)
+              for k in range(7)}
+    assert finals == expect
+    assert checkpoints >= 1   # distributed checkpointing completed
+
+
+def test_subtask_host_placement():
+    assert [subtask_host(i, 3) for i in range(6)] == [0, 1, 2, 0, 1, 2]
