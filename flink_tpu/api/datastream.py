@@ -533,7 +533,21 @@ class WindowedStream:
         assigner = self.assigner
         key_col = self.keyed.key_spec
         capacity = cfg.get(StateOptions.TPU_CAPACITY) or (1 << 16)
+        mesh_devices = cfg.get(StateOptions.MESH_DEVICES)
         spec = AggSpec(kind, field, out_name="result")
+
+        if mesh_devices and mesh_devices >= 2:
+            from ..runtime.operators.mesh_window import MeshWindowAggOperator
+
+            def factory():
+                return MeshWindowAggOperator(
+                    assigner, key_col, [spec], n_devices=mesh_devices,
+                    capacity=capacity, emit_window_bounds=False, name=name)
+
+            # the mesh IS the parallelism: one SPMD vertex owns all devices
+            return self.keyed._one_input(
+                name, factory, parallelism=1,
+                key_extractor=self.keyed.key_extractor)
 
         def factory():
             return DeviceWindowAggOperator(
@@ -564,6 +578,31 @@ class WindowedStream:
 
         par = 1 if self._all else None
         return self.keyed._one_input(name, factory, parallelism=par,
+                                     key_extractor=self.keyed.key_extractor)
+
+    def mesh_aggregate(self, aggs, n_devices: Optional[int] = None,
+                       capacity: int = 1 << 16, ring_size: int = 64,
+                       device_batch: int = 1 << 12,
+                       emit_window_bounds: bool = True,
+                       name: str = "MeshWindowAgg") -> DataStream:
+        """Window aggregation as ONE mesh-sharded SPMD vertex: keyBy is the
+        on-device all_to_all exchange, state is sharded by key-group range
+        across the mesh (parallel/sharded_window.py). The vertex has host
+        parallelism 1 — its real parallelism is the device mesh."""
+        from ..runtime.operators.mesh_window import MeshWindowAggOperator
+        if not isinstance(self.keyed.key_spec, str):
+            raise ValueError("mesh aggregation needs a column key")
+        assigner = self.assigner
+        key_col = self.keyed.key_spec
+
+        def factory():
+            return MeshWindowAggOperator(
+                assigner, key_col, aggs, n_devices=n_devices,
+                capacity=capacity, ring_size=ring_size,
+                device_batch=device_batch,
+                emit_window_bounds=emit_window_bounds, name=name)
+
+        return self.keyed._one_input(name, factory, parallelism=1,
                                      key_extractor=self.keyed.key_extractor)
 
 

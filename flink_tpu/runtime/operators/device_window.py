@@ -38,6 +38,7 @@ from ...ops.segment_ops import pane_window_merge
 from ...state.tpu_backend import TpuKeyedStateBackend
 from ...window.assigners import WindowAssigner
 from .base import OneInputOperator, OperatorContext, Output
+from .slice_control import SliceControlPlane
 
 __all__ = ["DeviceWindowAggOperator", "AggSpec"]
 
@@ -55,7 +56,7 @@ class AggSpec:
         self.dtype = dtype
 
 
-class DeviceWindowAggOperator(OneInputOperator):
+class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
     def __init__(self, assigner: WindowAssigner, key_column: str,
                  aggs: Sequence[AggSpec],
                  capacity: int = 1 << 16,
@@ -82,13 +83,7 @@ class DeviceWindowAggOperator(OneInputOperator):
         self._emit_bounds = emit_window_bounds
 
         self._backend: Optional[TpuKeyedStateBackend] = None
-        # host control-plane scalars: windows ending at pane boundary p_end
-        # for all p_end < _fired_boundary have fired; panes <
-        # _fired_boundary - W are retired (ring rows reusable, records late)
-        self._fired_boundary: Optional[int] = None
-        self._min_seen_pane: Optional[int] = None
-        self._max_seen_pane: Optional[int] = None
-        self._late_dropped = 0
+        self._init_control_plane()
         self._out_schema: Optional[Schema] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -120,22 +115,19 @@ class DeviceWindowAggOperator(OneInputOperator):
     def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
         if keyed_snapshots:
             self._backend.restore([s["backend"] for s in keyed_snapshots])
-            metas = [s["meta"] for s in keyed_snapshots]
-            fires = [m["fired_boundary"] for m in metas
-                     if m.get("fired_boundary") is not None]
-            seens = [m["max_seen_pane"] for m in metas
-                     if m["max_seen_pane"] is not None]
-            mins = [m["min_seen_pane"] for m in metas
-                    if m.get("min_seen_pane") is not None]
-            self._fired_boundary = min(fires) if fires else None
-            self._max_seen_pane = max(seens) if seens else None
-            self._min_seen_pane = min(mins) if mins else None
-            self.current_watermark = max(m["watermark"] for m in metas)
+            self._restore_control_meta([s["meta"] for s in keyed_snapshots])
+            # checkpoints taken under a different ring size re-seat their
+            # live pane rows onto this operator's ring
+            first = self._min_seen_pane
+            if first is not None and self._fired_boundary is not None:
+                first = max(first, self._fired_boundary - self._window_panes)
+            live = (range(first, self._max_seen_pane + 1)
+                    if first is not None else range(0))
+            self._backend.conform_ring(self._ring, live)
 
     # -- data path ---------------------------------------------------------
     def process_batch(self, batch: RecordBatch) -> None:
-        n = batch.n
-        if n == 0:
+        if batch.n == 0:
             return
         if not self._registered:
             key_dtype = batch.schema.field(self._key_column).dtype
@@ -147,37 +139,10 @@ class DeviceWindowAggOperator(OneInputOperator):
                     "state backend for float/string keys")
             self._register_aggs(batch.schema)
         keys = batch.column(self._key_column).astype(np.int64)
-        panes = ((batch.timestamps - self._offset) // self._pane).astype(
-            np.int64)
+        self._ingest(batch, keys)
 
-        # late = every window containing the pane has fired (its ring row
-        # may already be retired/reused)
-        if self._fired_boundary is not None:
-            first_open = self._fired_boundary - self._window_panes
-            late = panes < first_open
-            n_late = int(late.sum())
-            if n_late:
-                self._late_dropped += n_late
-                keep = ~late
-                keys, panes = keys[keep], panes[keep]
-                batch = batch.filter(keep)
-                if batch.n == 0:
-                    return
-        max_pane = int(panes.max())
-        min_pane = int(panes.min())
-        self._max_seen_pane = (max_pane if self._max_seen_pane is None
-                               else max(self._max_seen_pane, max_pane))
-        self._min_seen_pane = (min_pane if self._min_seen_pane is None
-                               else min(self._min_seen_pane, min_pane))
-        # ring overflow check: two open panes must never share a ring row
-        low = (self._fired_boundary - self._window_panes
-               if self._fired_boundary is not None else self._min_seen_pane)
-        if max_pane - low >= self._ring:
-            raise RuntimeError(
-                f"pane ring overflow: open span [{low},{max_pane}] exceeds "
-                f"ring {self._ring}; increase ring_size or reduce "
-                "watermark lag")
-
+    def _fold(self, batch: RecordBatch, keys: np.ndarray,
+              panes: np.ndarray) -> None:
         slots = self._backend.slots_for_batch(keys)
         ring_idx = jnp.asarray(panes % self._ring)
         valid = slots >= 0
@@ -192,28 +157,7 @@ class DeviceWindowAggOperator(OneInputOperator):
             self._backend.fold_batch(name, slots, col, valid,
                                      ring_idx=ring_idx)
 
-    # -- firing ------------------------------------------------------------
-    def process_watermark(self, watermark: Watermark) -> None:
-        self.current_watermark = watermark.timestamp
-        # a window ending at pane boundary p_end fires when
-        # wm >= p_end*pane + offset - 1
-        wm_pane_end = (watermark.timestamp - self._offset + 1) // self._pane
-        if self._max_seen_pane is not None:
-            # windows ending at or below min_seen contain no data; never
-            # reach below that (their ring rows may alias future panes)
-            start = self._min_seen_pane + 1
-            if self._fired_boundary is not None:
-                start = max(start, self._fired_boundary)
-            last = min(wm_pane_end, self._max_seen_pane + self._window_panes)
-            for p_end in range(start, last + 1):
-                self._fire(p_end)
-        # the boundary tracks the watermark even when no data has arrived
-        # yet or no window fired, so records behind the watermark are
-        # dropped as late exactly like the host operator
-        if self._fired_boundary is None or wm_pane_end + 1 > self._fired_boundary:
-            self._fired_boundary = wm_pane_end + 1
-        self.output.emit_watermark(watermark)
-
+    # -- firing (fire loop lives in SliceControlPlane) ----------------------
     def _fire(self, p_end: int) -> None:
         W = self._window_panes
         # never read panes below min_seen: they hold no data and their ring
@@ -272,13 +216,5 @@ class DeviceWindowAggOperator(OneInputOperator):
 
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:
-        return {"keyed": {
-            "backend": self._backend.snapshot(checkpoint_id),
-            "meta": {"fired_boundary": self._fired_boundary,
-                     "min_seen_pane": self._min_seen_pane,
-                     "max_seen_pane": self._max_seen_pane,
-                     "watermark": self.current_watermark}}}
-
-    @property
-    def late_dropped(self) -> int:
-        return self._late_dropped
+        return {"keyed": {"backend": self._backend.snapshot(checkpoint_id),
+                          "meta": self._control_meta()}}

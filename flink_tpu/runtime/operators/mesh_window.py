@@ -1,0 +1,453 @@
+"""Mesh slice-window operator: multi-chip execution inside a JobGraph.
+
+This is the deploy seam the reference crosses at Execution.deploy
+(flink-runtime executiongraph/Execution.java:511) ->
+TaskExecutor.submitTask (taskexecutor/TaskExecutor.java:634), re-thought
+for a TPU mesh: instead of N parallel subtasks connected by a hash
+repartition over the network, ONE JobGraph vertex executes as an SPMD
+program over an n-device `jax.sharding.Mesh`. The keyBy edge into the
+vertex is the on-device `all_to_all` exchange (parallel/exchange.py) —
+upstream host vertices just hand raw batches to this operator; key-group
+routing happens inside the compiled step, riding ICI instead of TCP.
+
+The host side of the operator is only a control plane: it buffers incoming
+batches into fixed [D, B] device blocks (static shapes so the step jits
+once) and runs the shared pane/watermark protocol (slice_control.py);
+fires are one pane-merge program over every shard's key-group range
+(WindowOperator.onEventTime:437 / SliceSharedWindowAggProcessor semantics,
+vectorized over all keys and all devices).
+
+State checkpointing (VERDICT #2): snapshots materialize per-shard hash
+tables + pane accumulators into the SAME key-group-partitioned format the
+single-chip TpuKeyedStateBackend emits ({"kind": "tpu", keys, key_groups,
+states}), so restore re-filters by the new mesh's shard ranges — a mesh
+job can rescale 8->4->8 devices, or hand its state to a single-chip run,
+the StateAssignmentOperation/KeyGroupRangeAssignment.java:63 contract.
+Key groups are always computed in the job's max-parallelism space, so
+mesh and host subtasks agree on ownership.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.keygroups import hash_batch, key_groups_for_hash_batch
+from ...core.records import RecordBatch, Schema
+from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, make_table
+from ...ops.segment_ops import AGG_INITS, make_accumulator
+from ...parallel.mesh import make_mesh
+from ...parallel.sharded_window import (
+    AggDef, ShardedWindowAgg, ShardedWindowState,
+)
+from ...window.assigners import WindowAssigner
+from .base import OneInputOperator, OperatorContext, Output
+from .device_window import AggSpec
+from .slice_control import SliceControlPlane
+
+__all__ = ["MeshWindowAggOperator"]
+
+
+class MeshWindowAggOperator(SliceControlPlane, OneInputOperator):
+    """Keyed slice-window aggregation executed over a device mesh."""
+
+    def __init__(self, assigner: WindowAssigner, key_column: str,
+                 aggs: Sequence[AggSpec],
+                 n_devices: Optional[int] = None,
+                 capacity: int = 1 << 16,
+                 ring_size: int = 64,
+                 device_batch: int = 1 << 12,
+                 emit_window_bounds: bool = True,
+                 name: str = "MeshWindowAgg"):
+        super().__init__(name)
+        pane = assigner.pane_size
+        if pane is None:
+            raise ValueError(
+                "Mesh window operator needs a pane-decomposable assigner "
+                "(tumbling, or sliding with size % slide == 0)")
+        self._assigner = assigner
+        self._pane = int(pane)
+        self._offset = int(getattr(assigner, "offset", 0))
+        size = getattr(assigner, "size", self._pane)
+        self._window_panes = int(size) // self._pane
+        self._ring = int(ring_size)
+        if self._ring < self._window_panes + 1:
+            raise ValueError("ring_size must exceed panes per window")
+        self._key_column = key_column
+        self._aggs = list(aggs)
+        self._capacity = capacity
+        self._device_batch = int(device_batch)
+        self._emit_bounds = emit_window_bounds
+        self._n_devices = n_devices
+
+        self._agg: Optional[ShardedWindowAgg] = None
+        self._state: Optional[ShardedWindowState] = None
+        self._init_control_plane()
+        self._dropped_seen = 0
+        self._dirty_since_check = False
+        # host-side staging buffers for [D, B] blocks
+        self._buf_keys: list[np.ndarray] = []
+        self._buf_panes: list[np.ndarray] = []
+        self._buf_cols: dict[str, list[np.ndarray]] = {}
+        self._buf_n = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def setup(self, ctx: OperatorContext, output: Output) -> None:
+        super().setup(ctx, output)
+        n = self._n_devices or len(jax.devices())
+        self._n_devices = n
+        # key groups must live in the job's max-parallelism space so mesh
+        # checkpoints interoperate with host subtasks and other mesh sizes
+        self._max_parallelism = ctx.max_parallelism
+        if self._max_parallelism < n:
+            raise ValueError(
+                f"pipeline max-parallelism ({self._max_parallelism}) must "
+                f"be >= mesh size ({n}); raise "
+                "pipeline.max-parallelism")
+        self._mesh = make_mesh(n)
+
+    def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
+        if not keyed_snapshots:
+            return
+        self._restore_control_meta([s["meta"] for s in keyed_snapshots])
+        self._restore_backends([s["backend"] for s in keyed_snapshots])
+
+    # -- agg program construction ------------------------------------------
+    def _aggdefs(self, schema: Schema) -> list[AggDef]:
+        """AggSpec -> AggDef list. Accumulator dtype follows the input
+        column (sum over int64 stays int64, matching the host operator);
+        avg accumulates a float sum plane and divides by count at emit."""
+        defs = []
+        for a in self._aggs:
+            if a.kind == "count":
+                defs.append(AggDef(a.out_name, "count", jnp.int64))
+            elif a.kind == "avg":
+                defs.append(AggDef(f"{a.out_name}.sum", "sum", jnp.float32))
+            else:
+                dt = (jnp.dtype(np.dtype(schema.field(a.field).dtype))
+                      if a.field in schema else jnp.dtype(a.dtype))
+                defs.append(AggDef(a.out_name, a.kind, dt))
+        return defs
+
+    @staticmethod
+    def _plane_name(a: AggSpec) -> str:
+        return f"{a.out_name}.sum" if a.kind == "avg" else a.out_name
+
+    def _build(self, defs: list[AggDef], capacity: Optional[int] = None
+               ) -> None:
+        self._agg = ShardedWindowAgg(
+            self._mesh, defs, capacity=capacity or self._capacity,
+            ring=self._ring, max_parallelism=self._max_parallelism)
+        self._state = self._agg.init_state()
+
+    # -- data path ---------------------------------------------------------
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        if self._agg is None:
+            key_dtype = batch.schema.field(self._key_column).dtype
+            if key_dtype is object or not np.issubdtype(np.dtype(key_dtype),
+                                                        np.integer):
+                raise TypeError(
+                    f"mesh window aggregation needs an integer key column; "
+                    f"{self._key_column!r} is {key_dtype}")
+            self._build(self._aggdefs(batch.schema))
+        keys = batch.column(self._key_column).astype(np.int64)
+        self._ingest(batch, keys)
+
+    def _fold(self, batch: RecordBatch, keys: np.ndarray,
+              panes: np.ndarray) -> None:
+        self._buf_keys.append(keys)
+        self._buf_panes.append(panes)
+        for a in self._aggs:
+            if a.kind == "count":
+                continue
+            self._buf_cols.setdefault(self._plane_name(a), []).append(
+                np.asarray(batch.column(a.field)))
+        self._buf_n += batch.n
+        if self._buf_n >= self._n_devices * self._device_batch:
+            self._flush(pad=False)
+
+    def _flush(self, pad: bool) -> None:
+        """Drain staged records into [D, B] device steps. With pad=False
+        only full D*B blocks run; with pad=True a final padded block
+        (valid mask) drains the remainder."""
+        if self._agg is None or self._buf_n == 0:
+            return
+        D, B = self._n_devices, self._device_batch
+        full = D * B
+        keys = np.concatenate(self._buf_keys)
+        panes = np.concatenate(self._buf_panes)
+        cols = {n: np.concatenate(vs) for n, vs in self._buf_cols.items()}
+        pos, total = 0, len(keys)
+        while total - pos >= full:
+            self._step_block(keys[pos:pos + full], panes[pos:pos + full],
+                             {n: c[pos:pos + full] for n, c in cols.items()},
+                             n_valid=full)
+            pos += full
+        rem = total - pos
+        if pad and rem:
+            pk = np.zeros(full, np.int64)
+            pp = np.zeros(full, np.int64)
+            pk[:rem] = keys[pos:]
+            pp[:rem] = panes[pos:]
+            pc = {}
+            for n, c in cols.items():
+                buf = np.zeros(full, c.dtype)
+                buf[:rem] = c[pos:]
+                pc[n] = buf
+            self._step_block(pk, pp, pc, n_valid=rem)
+            pos = total
+        self._buf_keys = [keys[pos:]] if pos < total else []
+        self._buf_panes = [panes[pos:]] if pos < total else []
+        self._buf_cols = ({n: [c[pos:]] for n, c in cols.items()}
+                          if pos < total else {})
+        self._buf_n = total - pos
+
+    def _step_block(self, keys: np.ndarray, panes: np.ndarray,
+                    cols: dict[str, np.ndarray], n_valid: int) -> None:
+        D, B = self._n_devices, self._device_batch
+        valid = np.zeros(D * B, bool)
+        valid[:n_valid] = True
+        dkeys = jnp.asarray(keys.reshape(D, B))
+        dpanes = jnp.asarray(panes.reshape(D, B))
+        dvalid = jnp.asarray(valid.reshape(D, B))
+        dcols = {n: jnp.asarray(c.reshape(D, B)) for n, c in cols.items()}
+        self._state, _processed = self._agg.step(
+            self._state, dkeys, dcols, dpanes, dvalid)
+        self._dirty_since_check = True
+
+    # -- firing (fire loop lives in SliceControlPlane) ----------------------
+    def _pre_fire_flush(self) -> None:
+        self._flush(pad=True)
+        self._check_pressure()
+
+    def _check_pressure(self) -> None:
+        """Hash-table health, checked only when steps ran since the last
+        check (no device sync on idle watermarks): grow (2x) before any
+        shard crosses the load-factor threshold; a recorded drop is a hard
+        error (the record is already lost — the mesh analog of the
+        single-chip backend's synchronous rehash loop, done lazily because
+        the step path never syncs with the host)."""
+        if self._state is None or not self._dirty_since_check:
+            return
+        self._dirty_since_check = False
+        occ, dropped = jax.device_get((
+            (self._state.table != jnp.int64(EMPTY_KEY)).sum(axis=1),
+            self._state.dropped.sum()))
+        if int(dropped) > self._dropped_seen:
+            raise RuntimeError(
+                f"mesh hash table overflow: {int(dropped)} records dropped "
+                f"(capacity {self._agg.capacity} per shard); raise "
+                "state.backend.tpu.slots-per-key-group")
+        if int(occ.max()) > 0.6 * self._agg.capacity:
+            self._grow(self._agg.capacity * 2)
+
+    def _grow(self, new_capacity: int) -> None:
+        snap = self._snapshot_backend()
+        defs = list(self._agg.aggs)
+        self._build(defs, capacity=new_capacity)
+        self._load_snapshot_into_state([snap])
+
+    # -- fire/emit ---------------------------------------------------------
+    def _fire(self, p_end: int) -> None:
+        if self._agg is None:
+            return
+        W = self._window_panes
+        # never read panes below min_seen: they hold no data and their ring
+        # rows may be occupied by live FUTURE panes (row aliasing)
+        first = max(p_end - W, self._min_seen_pane)
+        if first >= p_end:
+            return
+        pane_rows = np.array([(p % self._ring) for p in range(first, p_end)],
+                             dtype=np.int32)
+        results, emit = self._agg.fire(self._state, pane_rows)
+        self._emit(p_end, results, emit)
+        # retire the oldest pane of this window: no future window needs it
+        if p_end - W >= self._min_seen_pane:
+            self._state = self._agg.retire_row(self._state,
+                                               (p_end - W) % self._ring)
+
+    def _emit(self, p_end: int, results: dict, emit: jax.Array) -> None:
+        mask = np.asarray(jax.device_get(emit)).reshape(-1)
+        if not mask.any():
+            return
+        idx = np.flatnonzero(mask)
+        table = np.asarray(jax.device_get(self._state.table)).reshape(-1)
+        keys = table[idx]
+        count_name = next(a.name for a in self._agg.aggs
+                          if a.kind == "count")
+        host = {n: np.asarray(jax.device_get(v)).reshape(-1)[idx]
+                for n, v in results.items()}
+        start = (p_end - self._window_panes) * self._pane + self._offset
+        end = p_end * self._pane + self._offset
+        cols: dict[str, np.ndarray] = {self._key_column: keys}
+        fields: list[tuple[str, Any]] = [(self._key_column, np.int64)]
+        if self._emit_bounds:
+            cols["window_start"] = np.full(len(idx), start, np.int64)
+            cols["window_end"] = np.full(len(idx), end, np.int64)
+            fields += [("window_start", np.int64), ("window_end", np.int64)]
+        for a in self._aggs:
+            if a.kind == "avg":
+                s = host[f"{a.out_name}.sum"]
+                c = np.maximum(host[count_name], 1).astype(s.dtype)
+                vals = s / c
+            else:
+                vals = host[a.out_name]
+            cols[a.out_name] = vals
+            fields.append((a.out_name, vals.dtype.type))
+        schema = Schema(fields)
+        ts = np.full(len(idx), end - 1, np.int64)
+        self.output.emit(RecordBatch(schema, cols, ts))
+
+    # -- checkpointing ------------------------------------------------------
+    def _snapshot_backend(self) -> dict:
+        """Key-group-partitioned snapshot, format-compatible with
+        TpuKeyedStateBackend.snapshot (state/tpu_backend.py) so mesh and
+        single-chip runs restore each other's checkpoints."""
+        if self._agg is None:
+            return {"kind": "tpu", "keys": np.empty(0, np.int64),
+                    "key_groups": np.empty(0, np.int32), "states": {}}
+        table = np.asarray(jax.device_get(self._state.table))  # [D, cap]
+        host_accs = {n: np.asarray(jax.device_get(v))
+                     for n, v in self._state.accs.items()}  # [D, ring, cap]
+        keys_parts, group_parts = [], []
+        vals_parts: dict[str, list[np.ndarray]] = {
+            n: [] for n in host_accs}
+        for d in range(self._n_devices):
+            occupied = table[d] != np.int64(EMPTY_KEY)
+            keys_d = table[d][occupied]
+            keys_parts.append(keys_d)
+            group_parts.append(key_groups_for_hash_batch(
+                hash_batch(keys_d), self._max_parallelism))
+            slots = np.flatnonzero(occupied)
+            for n, acc in host_accs.items():
+                vals_parts[n].append(acc[d][:, slots])
+        keys = np.concatenate(keys_parts) if keys_parts else np.empty(
+            0, np.int64)
+        groups = (np.concatenate(group_parts) if group_parts
+                  else np.empty(0, np.int32))
+        states = {}
+        for a in self._agg.aggs:
+            vals = (np.concatenate(vals_parts[a.name], axis=-1)
+                    if vals_parts[a.name]
+                    else np.empty((self._ring, 0)))
+            states[a.name] = {"kind": a.kind,
+                              "dtype": str(np.dtype(a.dtype)),
+                              "ring": self._ring, "values": vals}
+        return {"kind": "tpu", "keys": keys, "key_groups": groups,
+                "states": states}
+
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        self._flush(pad=True)
+        return {"keyed": {"backend": self._snapshot_backend(),
+                          "meta": self._control_meta()}}
+
+    def _live_pane_span(self) -> range:
+        """Panes whose ring rows may hold live data (everything below has
+        been retired/zeroed)."""
+        if self._max_seen_pane is None:
+            return range(0)
+        first = self._min_seen_pane
+        if self._fired_boundary is not None:
+            first = max(first, self._fired_boundary - self._window_panes)
+        return range(first, self._max_seen_pane + 1)
+
+    def _remap_ring_rows(self, vals: np.ndarray, old_ring: int,
+                         kind: str, dtype) -> np.ndarray:
+        """Re-seat restored [old_ring, N] accumulator rows onto this
+        operator's ring: live panes move row (p % old_ring) ->
+        (p % new_ring); retired rows are the aggregate identity."""
+        if old_ring == self._ring:
+            return vals
+        span = self._live_pane_span()
+        if len(span) > self._ring:
+            raise RuntimeError(
+                f"cannot restore onto ring {self._ring}: {len(span)} panes "
+                "are live; increase ring_size")
+        identity = np.asarray(jax.device_get(AGG_INITS[kind](
+            jnp.dtype(dtype))))
+        out = np.full((self._ring, vals.shape[1]), identity,
+                      dtype=vals.dtype)
+        for p in span:
+            out[p % self._ring] = vals[p % old_ring]
+        return out
+
+    def _restore_backends(self, snaps: list[dict]) -> None:
+        snaps = [s for s in snaps if len(s.get("keys", ()))
+                 or s.get("states")]
+        if not snaps:
+            return
+        # agg program config comes from the snapshot itself (schema not yet
+        # seen at restore time), like the reference rebuilding serializers
+        # from their snapshots
+        meta = {}
+        for s in snaps:
+            meta.update(s["states"])
+        defs = [AggDef(n, m["kind"], jnp.dtype(m["dtype"]))
+                for n, m in meta.items()]
+        # capacity: smallest power of two giving every shard 2x headroom
+        n_keys = sum(len(s["keys"]) for s in snaps)
+        per_shard = max(1, (2 * n_keys) // self._n_devices)
+        cap = self._capacity
+        while cap < per_shard:
+            cap <<= 1
+        self._build(defs, capacity=cap)
+        self._load_snapshot_into_state(snaps)
+
+    def _load_snapshot_into_state(self, snaps: list[dict]) -> None:
+        """Filter restored keys into each shard's key-group range and
+        rebuild per-shard tables + accumulators (the
+        StateAssignmentOperation re-distribution step)."""
+        all_keys = np.concatenate(
+            [np.asarray(s["keys"], np.int64) for s in snaps])
+        all_groups = np.concatenate(
+            [np.asarray(s["key_groups"], np.int32) for s in snaps])
+        vals: dict[str, np.ndarray] = {}
+        for a in self._agg.aggs:
+            parts = []
+            for s in snaps:
+                sd = s.get("states", {}).get(a.name)
+                if sd is None:
+                    continue
+                parts.append(self._remap_ring_rows(
+                    np.asarray(sd["values"]), int(sd["ring"]),
+                    a.kind, a.dtype))
+            vals[a.name] = (np.concatenate(parts, axis=-1) if parts
+                            else np.empty((self._ring, 0)))
+        D, cap, ring = self._n_devices, self._agg.capacity, self._ring
+        tables = np.empty((D, cap), np.int64)
+        accs = {a.name: np.empty((D, ring, cap),
+                                 np.dtype(jnp.dtype(a.dtype).name))
+                for a in self._agg.aggs}
+        for d, rng in enumerate(self._agg.shard_ranges):
+            sel = (all_groups >= rng.start) & (all_groups <= rng.end)
+            keys_d = all_keys[sel]
+            table_d = make_table(cap)
+            if len(keys_d):
+                table_d, slots, ok = lookup_or_insert(
+                    table_d, jnp.asarray(keys_d))
+                if not bool(jax.device_get(ok.all())):
+                    raise RuntimeError(
+                        "mesh restore overflow: raise capacity")
+            tables[d] = np.asarray(jax.device_get(table_d))
+            for a in self._agg.aggs:
+                acc = np.array(jax.device_get(make_accumulator(
+                    a.kind, (ring, cap), a.dtype)))
+                if len(keys_d):
+                    acc[:, np.asarray(jax.device_get(slots))] = \
+                        vals[a.name][:, sel]
+                accs[a.name][d] = acc
+        sharding = self._agg._sharding
+        self._state = ShardedWindowState(
+            table=jax.device_put(jnp.asarray(tables), sharding),
+            accs={n: jax.device_put(jnp.asarray(v), sharding)
+                  for n, v in accs.items()},
+            dropped=jax.device_put(jnp.zeros(D, jnp.int64), sharding))
+
+    # -- teardown ----------------------------------------------------------
+    def finish(self) -> None:
+        self._flush(pad=True)

@@ -1,0 +1,148 @@
+"""Shared host control plane for slice-window device operators.
+
+Both the single-chip DeviceWindowAggOperator and the mesh
+MeshWindowAggOperator run the same scalar protocol around their compiled
+steps: pane arithmetic, late-record filtering, the watermark-driven fire
+loop, and the fired/seen-pane metadata that rides along with keyed
+snapshots. This mixin holds that protocol once (the analog of the logic in
+the reference's WindowOperator.processElement:278 / onEventTime:437 that
+is independent of the state backend), so a fix to the boundary math lands
+in every device operator.
+
+Subclasses provide:
+  _fold(batch, keys, panes)   — accumulate one filtered batch
+  _fire(p_end)                — merge + emit the window ending at pane
+                                boundary p_end, then retire its oldest row
+  _pre_fire_flush()           — drain any staged input (mesh buffering);
+                                default no-op
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ...core.elements import Watermark
+from ...core.records import RecordBatch
+
+__all__ = ["SliceControlPlane"]
+
+_MAX_FIRE_SAMPLES = 65536
+
+
+class SliceControlPlane:
+    # set by subclass __init__
+    _pane: int
+    _offset: int
+    _window_panes: int
+    _ring: int
+
+    def _init_control_plane(self) -> None:
+        # windows ending at pane boundary p_end for all p_end <
+        # _fired_boundary have fired; panes < _fired_boundary - W are
+        # retired (ring rows reusable, records late)
+        self._fired_boundary: Optional[int] = None
+        self._min_seen_pane: Optional[int] = None
+        self._max_seen_pane: Optional[int] = None
+        self._late_dropped = 0
+        # wall-clock of each window fire (merge + emit), for the p99
+        # window-fire latency metric (BASELINE.md); bounded reservoir
+        self.fire_latencies_ms: list[float] = []
+
+    # -- metadata ----------------------------------------------------------
+    def _control_meta(self) -> dict:
+        return {"fired_boundary": self._fired_boundary,
+                "min_seen_pane": self._min_seen_pane,
+                "max_seen_pane": self._max_seen_pane,
+                "watermark": self.current_watermark}
+
+    def _restore_control_meta(self, metas: list[dict]) -> None:
+        fires = [m["fired_boundary"] for m in metas
+                 if m.get("fired_boundary") is not None]
+        seens = [m["max_seen_pane"] for m in metas
+                 if m.get("max_seen_pane") is not None]
+        mins = [m["min_seen_pane"] for m in metas
+                if m.get("min_seen_pane") is not None]
+        self._fired_boundary = min(fires) if fires else None
+        self._max_seen_pane = max(seens) if seens else None
+        self._min_seen_pane = min(mins) if mins else None
+        self.current_watermark = max(m["watermark"] for m in metas)
+
+    # -- data path ---------------------------------------------------------
+    def _ingest(self, batch: RecordBatch, keys: np.ndarray) -> None:
+        """Late-filter + pane-span bookkeeping, then hand the surviving
+        records to the subclass's _fold."""
+        panes = ((batch.timestamps - self._offset) // self._pane).astype(
+            np.int64)
+        if self._fired_boundary is not None:
+            # late = every window containing the pane has fired (its ring
+            # row may already be retired/reused)
+            first_open = self._fired_boundary - self._window_panes
+            late = panes < first_open
+            n_late = int(late.sum())
+            if n_late:
+                self._late_dropped += n_late
+                keep = ~late
+                keys, panes = keys[keep], panes[keep]
+                batch = batch.filter(keep)
+                if batch.n == 0:
+                    return
+        max_pane = int(panes.max())
+        min_pane = int(panes.min())
+        self._max_seen_pane = (max_pane if self._max_seen_pane is None
+                               else max(self._max_seen_pane, max_pane))
+        self._min_seen_pane = (min_pane if self._min_seen_pane is None
+                               else min(self._min_seen_pane, min_pane))
+        # ring overflow check: two open panes must never share a ring row
+        low = (self._fired_boundary - self._window_panes
+               if self._fired_boundary is not None else self._min_seen_pane)
+        if max_pane - low >= self._ring:
+            raise RuntimeError(
+                f"pane ring overflow: open span [{low},{max_pane}] exceeds "
+                f"ring {self._ring}; increase ring_size or reduce "
+                "watermark lag")
+        self._fold(batch, keys, panes)
+
+    # -- firing ------------------------------------------------------------
+    def process_watermark(self, watermark: Watermark) -> None:
+        self.current_watermark = watermark.timestamp
+        self._pre_fire_flush()
+        # a window ending at pane boundary p_end fires when
+        # wm >= p_end*pane + offset - 1
+        wm_pane_end = (watermark.timestamp - self._offset + 1) // self._pane
+        if self._max_seen_pane is not None:
+            # windows ending at or below min_seen contain no data; never
+            # reach below that (their ring rows may alias future panes)
+            start = self._min_seen_pane + 1
+            if self._fired_boundary is not None:
+                start = max(start, self._fired_boundary)
+            last = min(wm_pane_end, self._max_seen_pane + self._window_panes)
+            for p_end in range(start, last + 1):
+                t0 = time.perf_counter()
+                self._fire(p_end)
+                if len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES:
+                    self.fire_latencies_ms.append(
+                        (time.perf_counter() - t0) * 1e3)
+        # the boundary tracks the watermark even when no data has arrived
+        # yet or no window fired, so records behind the watermark are
+        # dropped as late exactly like the host operator
+        if (self._fired_boundary is None
+                or wm_pane_end + 1 > self._fired_boundary):
+            self._fired_boundary = wm_pane_end + 1
+        self.output.emit_watermark(watermark)
+
+    def _pre_fire_flush(self) -> None:
+        pass
+
+    def _fold(self, batch: RecordBatch, keys: np.ndarray,
+              panes: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def _fire(self, p_end: int) -> None:
+        raise NotImplementedError
+
+    @property
+    def late_dropped(self) -> int:
+        return self._late_dropped

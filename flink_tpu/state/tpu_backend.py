@@ -154,6 +154,26 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 st.array = st.array.at[row].set(
                     AGG_INITS[st.kind](st.array.dtype))
 
+    def conform_ring(self, ring: int, live_panes: Iterable[int]) -> None:
+        """Re-seat ring-shaped array states restored under a DIFFERENT ring
+        size onto ``ring`` rows: each live pane's row moves from
+        (p % old_ring) to (p % ring); every other row is the aggregate
+        identity (retired). No-op when sizes already match."""
+        live = list(live_panes)
+        for st in self._array_states.values():
+            if not st.ring or st.ring == ring:
+                continue
+            if len(live) > ring:
+                raise RuntimeError(
+                    f"cannot conform ring {st.ring} -> {ring}: "
+                    f"{len(live)} panes are live; increase ring_size")
+            old = st.array
+            new = make_accumulator(st.kind, (ring, self.capacity), st.dtype)
+            for p in live:
+                new = new.at[p % ring].set(old[p % st.ring])
+            st.array = new
+            st.ring = ring
+
     def occupied_mask(self) -> jax.Array:
         return self.table != EMPTY_KEY
 

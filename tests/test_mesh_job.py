@@ -1,0 +1,349 @@
+"""Mesh window operator inside the framework: parity, env.execute(),
+checkpoint/restore with mesh rescale (VERDICT #1/#2).
+
+Runs on the 8-device virtual CPU platform (conftest). Parity oracle is the
+host WindowOperator (itself the reference-semantics twin of
+WindowOperator.java:278), the same discipline as tests/test_device.py.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import Schema
+
+
+SCHEMA = Schema([("key", np.int64), ("v", np.int64)])
+
+
+def _host_window_result(elements, ts, window):
+    from flink_tpu.core.functions import AggregateFunction
+    from flink_tpu.runtime import OneInputOperatorTestHarness
+    from flink_tpu.runtime.operators import WindowOperator
+
+    class Agg(AggregateFunction):
+        def create_accumulator(self):
+            return 0
+
+        def add(self, value, acc):
+            return acc + value[1]
+
+        def merge(self, a, b):
+            return a + b
+
+        def get_result(self, acc):
+            return acc
+
+    def extract(batch):
+        return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+
+    op = WindowOperator(window, extract, aggregate=Agg())
+    h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+    h.process_elements(elements, ts)
+    h.process_watermark(10**9)
+    return sorted((int(k), int(v)) for k, v in h.get_output())
+
+
+def _mesh_op(assigner, n_devices=8, **kw):
+    from flink_tpu.runtime.operators.device_window import AggSpec
+    from flink_tpu.runtime.operators.mesh_window import MeshWindowAggOperator
+    kw.setdefault("capacity", 1 << 10)
+    kw.setdefault("device_batch", 64)
+    return MeshWindowAggOperator(
+        assigner, "key", [AggSpec("sum", "v", out_name="result")],
+        n_devices=n_devices, emit_window_bounds=False, **kw)
+
+
+def _run_mesh(elements, ts, assigner, n_devices=8, **kw):
+    from flink_tpu.runtime import OneInputOperatorTestHarness
+    h = OneInputOperatorTestHarness(_mesh_op(assigner, n_devices, **kw),
+                                    schema=SCHEMA)
+    h.process_elements(elements, ts)
+    h.process_watermark(10**9)
+    return sorted((int(k), int(v)) for k, v in h.get_output())
+
+
+def _gen(seed, n, n_keys=50, t_max=10_000):
+    rng = np.random.default_rng(seed)
+    elements = [(int(k), int(v)) for k, v in
+                zip(rng.integers(0, n_keys, n), rng.integers(1, 10, n))]
+    ts = sorted(rng.integers(0, t_max, n).tolist())
+    return elements, ts
+
+
+class TestMeshWindowParity:
+    def test_tumbling_parity_with_host(self):
+        from flink_tpu.window import TumblingEventTimeWindows
+        elements, ts = _gen(11, 700)
+        w = TumblingEventTimeWindows.of(1000)
+        assert _run_mesh(elements, ts, w) == _host_window_result(
+            elements, ts, w)
+
+    def test_sliding_parity_with_host(self):
+        from flink_tpu.window import SlidingEventTimeWindows
+        elements, ts = _gen(12, 500, n_keys=20, t_max=5000)
+        w = SlidingEventTimeWindows.of(1000, 250)
+        assert _run_mesh(elements, ts, w) == _host_window_result(
+            elements, ts, w)
+
+    def test_parity_with_single_chip_device_op(self):
+        """Mesh result == single-chip device operator result, same data."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        from flink_tpu.window import TumblingEventTimeWindows
+        elements, ts = _gen(13, 400)
+        w = TumblingEventTimeWindows.of(500)
+        mesh = _run_mesh(elements, ts, w)
+        op = DeviceWindowAggOperator(
+            w, "key", [AggSpec("sum", "v", out_name="result")],
+            capacity=1 << 10, emit_window_bounds=False)
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        h.process_elements(elements, ts)
+        h.process_watermark(10**9)
+        single = sorted((int(k), int(v)) for k, v in h.get_output())
+        assert mesh == single
+
+    def test_incremental_watermarks(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(100)
+        h = OneInputOperatorTestHarness(_mesh_op(w), schema=SCHEMA)
+        h.process_elements([(1, 5), (2, 7)], [10, 20])
+        h.process_watermark(99)
+        h.process_elements([(1, 3)], [150])
+        h.process_watermark(199)
+        out = sorted((int(k), int(v)) for k, v in h.get_output())
+        assert out == [(1, 3), (1, 5), (2, 7)]
+
+    def test_late_records_dropped(self):
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(100)
+        op = _mesh_op(w)
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        h.process_elements([(1, 5)], [10])
+        h.process_watermark(299)
+        h.process_elements([(1, 9)], [20])  # late
+        h.process_watermark(399)
+        out = sorted((int(k), int(v)) for k, v in h.get_output())
+        assert out == [(1, 5)]
+        assert op.late_dropped == 1
+
+    def test_auto_grow_capacity(self):
+        """More keys than initial capacity: the operator grows at watermark
+        boundaries instead of dropping."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(1_000_000)
+        op = _mesh_op(w, capacity=64, device_batch=32)
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        n_keys = 600  # >> 8 shards * 64 slots
+        for lot in range(6):
+            ks = np.arange(lot * 100, lot * 100 + 100, dtype=np.int64)
+            h.process_elements([(int(k), 1) for k in ks],
+                               [lot + 1] * 100)
+            h.process_watermark(lot + 1)
+        h.process_watermark(10**9)
+        out = sorted((int(k), int(v)) for k, v in h.get_output())
+        assert len(out) == n_keys
+        assert all(v == 1 for _k, v in out)
+
+
+class TestMeshCheckpointRescale:
+    def _run_with_restore(self, n_before, n_after, elements, ts, cut):
+        """Process first `cut` records on an n_before-device mesh, snapshot,
+        restore onto n_after devices, finish, return fired output."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import TumblingEventTimeWindows
+        w = TumblingEventTimeWindows.of(1000)
+        h1 = OneInputOperatorTestHarness(_mesh_op(w, n_before), schema=SCHEMA)
+        h1.process_elements(elements[:cut], ts[:cut])
+        h1.process_watermark(ts[cut - 1])
+        snap = h1.operator.snapshot_state(1)["keyed"]
+
+        h2 = OneInputOperatorTestHarness(_mesh_op(w, n_after), schema=SCHEMA)
+        h2.open(keyed_snapshots=[snap])
+        h2.process_elements(elements[cut:], ts[cut:])
+        h2.process_watermark(10**9)
+        early = sorted((int(k), int(v)) for k, v in h1.get_output())
+        late = sorted((int(k), int(v)) for k, v in h2.get_output())
+        return sorted(early + late)
+
+    @pytest.mark.parametrize("n_before,n_after", [(8, 4), (4, 8), (8, 8)])
+    def test_rescale_parity(self, n_before, n_after):
+        from flink_tpu.window import TumblingEventTimeWindows
+        elements, ts = _gen(21, 600, n_keys=40)
+        w = TumblingEventTimeWindows.of(1000)
+        host = _host_window_result(elements, ts, w)
+        # cut on a window boundary-free spot mid-stream
+        got = self._run_with_restore(n_before, n_after, elements, ts,
+                                     cut=300)
+        assert got == host
+
+    @pytest.mark.parametrize("ring_after", [16, 128])
+    def test_restore_onto_different_ring_size(self, ring_after):
+        """A checkpoint taken with ring 64 restores onto a bigger or
+        smaller ring: live pane rows are re-seated at (p % new_ring)."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.window import SlidingEventTimeWindows
+        elements, ts = _gen(23, 400, n_keys=25, t_max=4000)
+        w = SlidingEventTimeWindows.of(1000, 250)
+        host = _host_window_result(elements, ts, w)
+        h1 = OneInputOperatorTestHarness(_mesh_op(w, 8), schema=SCHEMA)
+        h1.process_elements(elements[:200], ts[:200])
+        h1.process_watermark(ts[199])
+        snap = h1.operator.snapshot_state(1)["keyed"]
+        h2 = OneInputOperatorTestHarness(
+            _mesh_op(w, 8, ring_size=ring_after), schema=SCHEMA)
+        h2.open(keyed_snapshots=[snap])
+        h2.process_elements(elements[200:], ts[200:])
+        h2.process_watermark(10**9)
+        early = sorted((int(k), int(v)) for k, v in h1.get_output())
+        late = sorted((int(k), int(v)) for k, v in h2.get_output())
+        assert sorted(early + late) == host
+
+    def test_single_chip_restore_onto_different_ring(self):
+        """Same contract on the single-chip operator (conform_ring)."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        from flink_tpu.window import SlidingEventTimeWindows
+        elements, ts = _gen(24, 300, n_keys=15, t_max=3000)
+        w = SlidingEventTimeWindows.of(1000, 250)
+        host = _host_window_result(elements, ts, w)
+
+        def op(ring):
+            return DeviceWindowAggOperator(
+                w, "key", [AggSpec("sum", "v", out_name="result")],
+                capacity=1 << 9, ring_size=ring, emit_window_bounds=False)
+
+        h1 = OneInputOperatorTestHarness(op(64), schema=SCHEMA)
+        h1.process_elements(elements[:150], ts[:150])
+        h1.process_watermark(ts[149])
+        snap = h1.operator.snapshot_state(1)["keyed"]
+        h2 = OneInputOperatorTestHarness(op(32), schema=SCHEMA)
+        h2.open(keyed_snapshots=[snap])
+        h2.process_elements(elements[150:], ts[150:])
+        h2.process_watermark(10**9)
+        early = sorted((int(k), int(v)) for k, v in h1.get_output())
+        late = sorted((int(k), int(v)) for k, v in h2.get_output())
+        assert sorted(early + late) == host
+
+    def test_mesh_restores_single_chip_snapshot(self):
+        """Snapshot format parity: a single-chip DeviceWindowAggOperator
+        checkpoint restores onto the mesh (and the job continues)."""
+        from flink_tpu.runtime import OneInputOperatorTestHarness
+        from flink_tpu.runtime.operators.device_window import (
+            AggSpec, DeviceWindowAggOperator,
+        )
+        from flink_tpu.window import TumblingEventTimeWindows
+        elements, ts = _gen(22, 400, n_keys=30)
+        w = TumblingEventTimeWindows.of(1000)
+        host = _host_window_result(elements, ts, w)
+
+        op1 = DeviceWindowAggOperator(
+            w, "key", [AggSpec("sum", "v", out_name="result")],
+            capacity=1 << 10, emit_window_bounds=False)
+        h1 = OneInputOperatorTestHarness(op1, schema=SCHEMA)
+        h1.process_elements(elements[:200], ts[:200])
+        h1.process_watermark(ts[199])
+        snap = op1.snapshot_state(1)["keyed"]
+
+        h2 = OneInputOperatorTestHarness(_mesh_op(w), schema=SCHEMA)
+        h2.open(keyed_snapshots=[snap])
+        h2.process_elements(elements[200:], ts[200:])
+        h2.process_watermark(10**9)
+        early = sorted((int(k), int(v)) for k, v in h1.get_output())
+        late = sorted((int(k), int(v)) for k, v in h2.get_output())
+        assert sorted(early + late) == host
+
+
+class TestMeshPipeline:
+    def test_env_execute_mesh_q5_parity(self):
+        """Nexmark Q5 shape end-to-end via env.execute() on the 8-device
+        mesh: datagen -> keyBy -> sliding window count -> collect; parity
+        against the host-backend run of the same pipeline."""
+        from flink_tpu.api import StreamExecutionEnvironment
+        from flink_tpu.core import WatermarkStrategy
+        from flink_tpu.core.records import Schema as S
+        from flink_tpu.window import SlidingEventTimeWindows
+
+        schema = S([("auction", np.int64), ("price", np.int64),
+                    ("ts", np.int64)])
+        rng_seed = 5
+
+        def gen(idx):
+            rng = np.random.default_rng(rng_seed + idx[0] if len(idx) else 0)
+            return {"auction": idx % 97,
+                    "price": (idx * 7) % 100 + 1,
+                    "ts": idx * 3}
+
+        def run(backend, mesh_devices):
+            env = StreamExecutionEnvironment.get_execution_environment()
+            env.set_state_backend(backend)
+            if mesh_devices:
+                from flink_tpu.core.config import StateOptions
+                env.config.set(StateOptions.MESH_DEVICES, mesh_devices)
+            ws = WatermarkStrategy.for_monotonous_timestamps() \
+                .with_timestamp_column("ts")
+            out = (env.datagen(gen, schema, count=3000,
+                               timestamp_column="ts",
+                               watermark_strategy=ws)
+                   .key_by("auction")
+                   .window(SlidingEventTimeWindows.of(1000, 500))
+                   .sum("price")
+                   .execute_and_collect())
+            return sorted((int(k), int(v)) for k, v in out)
+
+        mesh = run("tpu", 8)
+        host = run("hashmap", 0)
+        assert mesh == host
+
+    def test_mesh_aggregate_explicit_api(self):
+        """Explicit mesh_aggregate with multiple aggs incl. avg + window
+        bounds."""
+        from flink_tpu.api import StreamExecutionEnvironment
+        from flink_tpu.core import WatermarkStrategy
+        from flink_tpu.core.records import Schema as S
+        from flink_tpu.runtime.operators.device_window import AggSpec
+        from flink_tpu.window import TumblingEventTimeWindows
+
+        schema = S([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+
+        def gen(idx):
+            return {"k": idx % 5, "v": idx % 11, "ts": idx * 2}
+
+        env = StreamExecutionEnvironment.get_execution_environment()
+        ws = WatermarkStrategy.for_monotonous_timestamps() \
+            .with_timestamp_column("ts")
+        rows = (env.datagen(gen, schema, count=1000, timestamp_column="ts",
+                            watermark_strategy=ws)
+                .key_by("k")
+                .window(TumblingEventTimeWindows.of(400))
+                .mesh_aggregate(
+                    [AggSpec("sum", "v", out_name="total"),
+                     AggSpec("count", out_name="cnt"),
+                     AggSpec("max", "v", out_name="hi"),
+                     AggSpec("avg", "v", out_name="mean")],
+                    n_devices=8, capacity=1 << 8, device_batch=64)
+                .execute_and_collect())
+        # oracle: recompute on host
+        import collections
+        buckets = collections.defaultdict(list)
+        for i in range(1000):
+            buckets[(i % 5, (i * 2) // 400)].append(i % 11)
+        expect = {}
+        for (k, w), vs in buckets.items():
+            expect[(k, w * 400, w * 400 + 400)] = (
+                sum(vs), len(vs), max(vs), sum(vs) / len(vs))
+        got = {}
+        for k, wstart, wend, total, cnt, hi, mean in rows:
+            got[(int(k), int(wstart), int(wend))] = (
+                int(total), int(cnt), int(hi), float(mean))
+        assert set(got) == set(expect)
+        for key, (total, cnt, hi, mean) in expect.items():
+            gt, gc, gh, gm = got[key]
+            assert (gt, gc, gh) == (total, cnt, hi)
+            assert abs(gm - mean) < 1e-5
