@@ -1,15 +1,29 @@
-"""Headline benchmark: Nexmark Q5-shaped hot-items aggregation.
+"""Headline benchmark: Nexmark Q5 through the FRAMEWORK, not the kernels.
 
-Measures steady-state events/sec of the device micro-batch fold (the
-north-star hot path: hash-table lookup-or-insert + scatter-fold pane
-accumulation over 1M active keys, BASELINE.md config #3) on whatever chip
-jax.devices()[0] is, and compares against an in-process per-record host
-loop over a Python dict — the analog of the reference's heap-backend
-WindowOperator.processElement hot loop (WindowOperator.java:278), which is
-itself faster per-core than the RocksDB backend the target is defined
-against.
+The default run drives a Nexmark-Q5-shaped job through ``env.execute()``:
+datagen source -> keyBy -> sliding-window aggregate on the device
+slice-window operator (hash-table lookup-or-insert + scatter-fold pane
+accumulation + device top-k fire) -> sink, at 1M active keys — the whole
+StreamTask/channel/watermark/operator path, measured end to end on
+whatever chip jax.devices()[0] is (BASELINE.md config #3; reference hot
+loop WindowOperator.java:278). ``vs_baseline`` compares against an
+in-process per-record host dict loop (the heap-backend analog, itself
+faster per-core than the RocksDB backend the target is defined against).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``--suite`` prints one JSON line per metric:
+  * framework Q5 @1M and @10M keys (events/sec + p99 window-fire latency)
+  * framework Q7 @10M keys — windowed max with the join lowered TPU-first:
+    the winning bid's payload rides a packed (price<<20|bidder) word
+    through the max lattice, so the join-with-max collapses into an argmax
+    (reference Q7 join: MAX(price) subquery join; StreamExecLocal/Global
+    two-phase shape)
+  * framework Q7-join variant — device windowed max joined back against
+    the bid stream through the host IntervalJoinOperator (a REAL two-input
+    join in the job), smaller scale
+  * raw kernel ceiling (the hand-inlined jitted step), for the honest gap
+    between kernel and framework path
+
+Each line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 from __future__ import annotations
@@ -22,8 +36,8 @@ import numpy as np
 
 N_KEYS = 1_000_000
 CAPACITY = 1 << 21          # 2x keys, power of two
-RING = 8
-BATCH = 1 << 17
+RING = 16
+BATCH = 1 << 19
 N_BATCHES = 8               # distinct pre-generated batches, cycled
 WARMUP = 3
 WINDOW_ITERS = 8            # steps per timed window
@@ -32,18 +46,249 @@ N_WINDOWS = 6               # report the median window (the chip sits
                             # contention spikes that a single window can't)
 HOST_EVENTS = 400_000
 
+MULT = 0x9E3779B97F4A7C15   # odd 64-bit mixer: idx -> pseudo-uniform key
+
+
+def _median(xs):
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
 
 def _median_window_eps(run_window) -> float:
     """Run N_WINDOWS timed windows; each returns events/sec; report the
     median."""
-    rates = []
-    for w in range(N_WINDOWS):
-        rates.append(run_window(w))
-    rates.sort()
-    mid = len(rates) // 2
-    return (rates[mid] if len(rates) % 2
-            else 0.5 * (rates[mid - 1] + rates[mid]))
+    return _median([run_window(w) for w in range(N_WINDOWS)])
 
+
+def _p99(xs) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+
+# ----------------------------------------------------------------------
+# framework path (env.execute)
+# ----------------------------------------------------------------------
+
+class _CountSink:
+    """Vectorized discard sink that counts rows."""
+
+    def __init__(self):
+        from flink_tpu.core.functions import SinkFunction
+
+        class _S(SinkFunction):
+            def __init__(s):
+                s.rows = 0
+
+            def invoke_batch(s, batch):
+                s.rows += batch.n
+                return True
+
+        self.fn = _S()
+
+    @property
+    def rows(self):
+        return self.fn.rows
+
+
+def _find_ops(env, cls):
+    ops = []
+    for task in env.last_job.tasks.values():
+        chain = getattr(task, "chain", None)
+        if chain is not None:
+            ops += [o for o in chain.operators if isinstance(o, cls)]
+    return ops
+
+
+def _n_panes(n_events: int) -> int:
+    """Panes sized so one source batch (= one watermark) advances well
+    under a pane: the open span stays inside the accumulator ring."""
+    return max(4, min(24, n_events // BATCH))
+
+
+def _run_q5(n_keys: int, n_events: int, capacity: int,
+            pane_ms: int = 2000, topk: int = 1000):
+    """One env.execute() of the Q5 pipeline; returns (wall_seconds,
+    fire_latencies_ms, emitted_rows)."""
+    import jax
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core import WatermarkStrategy
+    from flink_tpu.core.config import PipelineOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime.operators.device_window import (
+        AggSpec, DeviceWindowAggOperator,
+    )
+    from flink_tpu.window import SlidingEventTimeWindows
+
+    schema = Schema([("auction", np.int64), ("price", np.int64),
+                     ("ts", np.int64)])
+    span = _n_panes(n_events) * pane_ms
+
+    def gen(idx):
+        u = idx.astype(np.uint64)
+        auction = ((u * np.uint64(MULT)) % np.uint64(n_keys)).astype(np.int64)
+        return {"auction": auction,
+                "price": (idx % 997) + 1,
+                "ts": (idx * span) // n_events}
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, BATCH)
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _CountSink()
+    (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
+                 watermark_strategy=ws)
+        .key_by("auction")
+        .window(SlidingEventTimeWindows.of(5 * pane_ms, pane_ms))
+        .device_aggregate([AggSpec("count", out_name="bids")],
+                          capacity=capacity, ring_size=RING,
+                          emit_window_bounds=False, emit_topk=topk,
+                          defer_overflow=True, async_fire=True)
+        .add_sink(sink.fn, "count"))
+    t0 = time.perf_counter()
+    env.execute("nexmark-q5", timeout=1800.0)
+    wall = time.perf_counter() - t0
+    ops = _find_ops(env, DeviceWindowAggOperator)
+    lat = [ms for o in ops for ms in o.fire_latencies_ms]
+    return wall, lat, sink.rows
+
+
+def bench_framework_q5(n_keys: int, n_events: int, capacity: int):
+    """Warmup run (compile) + timed run; returns (events/sec, p99 ms)."""
+    _run_q5(n_keys, min(n_events, 4 * BATCH), capacity)     # compile warmup
+    wall, lat, _rows = _run_q5(n_keys, n_events, capacity)
+    return n_events / wall, _p99(lat)
+
+
+def _run_q7(n_keys: int, n_events: int, capacity: int,
+            pane_ms: int = 10_000):
+    """Q7 TPU-first: per-window winning bid via packed argmax. The packed
+    (price<<20 | bidder) word makes MAX carry the winner's payload, so the
+    reference's join-with-MAX-subquery collapses into one keyed max +
+    top-1 fire."""
+    import jax
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core import WatermarkStrategy
+    from flink_tpu.core.config import PipelineOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime.operators.device_window import (
+        AggSpec, DeviceWindowAggOperator,
+    )
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    schema = Schema([("auction", np.int64), ("packed", np.int64),
+                     ("ts", np.int64)])
+    span = _n_panes(n_events) * pane_ms
+
+    def gen(idx):
+        u = idx.astype(np.uint64)
+        auction = ((u * np.uint64(MULT)) % np.uint64(n_keys)).astype(np.int64)
+        price = (idx % 9973) + 1
+        bidder = idx % (1 << 20)
+        return {"auction": auction,
+                "packed": (price << 20) | bidder,
+                "ts": (idx * span) // n_events}
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, BATCH)
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _CountSink()
+    (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
+                 watermark_strategy=ws)
+        .key_by("auction")
+        .window(TumblingEventTimeWindows.of(pane_ms))
+        .device_aggregate([AggSpec("max", "packed", out_name="best")],
+                          capacity=capacity, ring_size=RING,
+                          emit_window_bounds=True, emit_topk=1,
+                          defer_overflow=True, async_fire=True)
+        .add_sink(sink.fn, "count"))
+    t0 = time.perf_counter()
+    env.execute("nexmark-q7", timeout=1800.0)
+    wall = time.perf_counter() - t0
+    ops = _find_ops(env, DeviceWindowAggOperator)
+    lat = [ms for o in ops for ms in o.fire_latencies_ms]
+    return wall, lat, sink.rows
+
+
+def bench_framework_q7(n_keys: int, n_events: int, capacity: int):
+    _run_q7(n_keys, min(n_events, 4 * BATCH), capacity)     # compile warmup
+    wall, lat, _rows = _run_q7(n_keys, n_events, capacity)
+    return n_events / wall, _p99(lat)
+
+
+def bench_framework_q7_join(n_keys: int = 100_000, n_events: int = 1 << 18,
+                            pane_ms: int = 10_000, n_panes: int = 8):
+    """Q7 with a REAL two-input join in the job: device windowed max per
+    auction, joined back against the bid stream through the host
+    IntervalJoinOperator (sql/join.py), filtered to price == window max —
+    the reference's bids JOIN (SELECT MAX...) shape with the join executed
+    as an operator, at host-join scale."""
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core import WatermarkStrategy
+    from flink_tpu.core.config import PipelineOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime.operators.device_window import AggSpec
+    from flink_tpu.sql.join import IntervalJoinOperator
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    schema = Schema([("auction", np.int64), ("price", np.int64),
+                     ("ts", np.int64)])
+    span = n_panes * pane_ms
+
+    def gen(idx):
+        u = idx.astype(np.uint64)
+        auction = ((u * np.uint64(MULT)) % np.uint64(n_keys)).astype(np.int64)
+        return {"auction": auction, "price": (idx % 9973) + 1,
+                "ts": (idx * span) // n_events}
+
+    def build(env):
+        ws = WatermarkStrategy.for_monotonous_timestamps() \
+            .with_timestamp_column("ts")
+        bids = env.datagen(gen, schema, count=n_events,
+                           timestamp_column="ts", watermark_strategy=ws)
+        maxes = (bids.key_by("auction")
+                 .window(TumblingEventTimeWindows.of(pane_ms))
+                 .device_aggregate([AggSpec("max", "price",
+                                            out_name="maxprice")],
+                                   capacity=1 << 18, ring_size=RING,
+                                   emit_window_bounds=False))
+        out_schema = Schema([("m_auction", np.int64),
+                             ("maxprice", np.int64),
+                             ("auction", np.int64), ("price", np.int64),
+                             ("ts", np.int64)])
+
+        def join_factory():
+            # max row ts = window_end - 1; matching bids lie within
+            # [end - pane, end - 1] -> offsets [-(pane-1), 0]
+            return IntervalJoinOperator(0, 0, -(pane_ms - 1), 0,
+                                        out_schema, name="q7-join")
+
+        joined = maxes.connect(bids).transform("q7-join", join_factory)
+        sink = _CountSink()
+        (joined.filter(lambda row: row[3] == row[1], name="is-winner")
+               .add_sink(sink.fn, "count"))
+        return sink
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, 1 << 15)
+    sink = build(env)
+    t0 = time.perf_counter()
+    env.execute("nexmark-q7-join", timeout=1800.0)
+    wall = time.perf_counter() - t0
+    if sink.rows == 0:
+        raise RuntimeError("q7 join produced no winners")
+    return n_events / wall
+
+
+# ----------------------------------------------------------------------
+# kernel ceiling (raw jitted step, no framework)
+# ----------------------------------------------------------------------
 
 def bench_device() -> float:
     import jax
@@ -101,68 +346,9 @@ def bench_device() -> float:
     return _median_window_eps(window)
 
 
-def bench_device_q7() -> float:
-    """Nexmark Q7: highest bid (price + argmax payload) per window pane.
-    Device shape: scatter-max of price into per-pane slots plus a second
-    scatter that captures the winning bid's payload via price-ordered
-    max of a packed (price << 20 | bidder) word — one fused XLA program."""
-    import jax
-    import jax.numpy as jnp
-    from flink_tpu.ops.hash_table import ensure_x64
-
-    ensure_x64()
-
-    @jax.jit
-    def step(pane_max, pane_packed, prices, bidders, panes):
-        # max price per pane
-        pane_max = pane_max.at[panes].max(prices)
-        # packed word keeps the argmax payload attached to the price order
-        packed = (prices.astype(jnp.int64) << 20) | bidders
-        pane_packed = pane_packed.at[panes].max(packed)
-        return pane_max, pane_packed
-
-    rng = np.random.default_rng(7)
-    prices_h = rng.integers(0, 1 << 40, (N_BATCHES, BATCH)).astype(np.int64)
-    bidders_h = rng.integers(0, 1 << 20, (N_BATCHES, BATCH)).astype(np.int64)
-    panes_h = rng.integers(0, RING, (N_BATCHES, BATCH)).astype(np.int64)
-    dev = jax.devices()[0]
-    prices = [jax.device_put(jnp.asarray(p), dev) for p in prices_h]
-    bidders = [jax.device_put(jnp.asarray(b), dev) for b in bidders_h]
-    panes = [jax.device_put(jnp.asarray(p), dev) for p in panes_h]
-    pane_max = jnp.zeros(RING, jnp.int64)
-    pane_packed = jnp.zeros(RING, jnp.int64)
-
-    state = [pane_max, pane_packed]
-    for i in range(WARMUP):
-        j = i % N_BATCHES
-        state = list(step(*state, prices[j], bidders[j], panes[j]))
-    jax.block_until_ready(state[0])
-
-    def window(w: int) -> float:
-        t0 = time.perf_counter()
-        for i in range(WINDOW_ITERS):
-            j = (w * WINDOW_ITERS + i) % N_BATCHES
-            state[:] = step(*state, prices[j], bidders[j], panes[j])
-        jax.block_until_ready(tuple(state))
-        return WINDOW_ITERS * BATCH / (time.perf_counter() - t0)
-
-    return _median_window_eps(window)
-
-
-def bench_host_q7() -> float:
-    rng = np.random.default_rng(7)
-    prices = rng.integers(0, 1 << 40, HOST_EVENTS).tolist()
-    bidders = rng.integers(0, 1 << 20, HOST_EVENTS).tolist()
-    panes = rng.integers(0, RING, HOST_EVENTS).tolist()
-    best: dict = {}
-    t0 = time.perf_counter()
-    for p, b, w in zip(prices, bidders, panes):
-        cur = best.get(w)
-        if cur is None or p > cur[0]:
-            best[w] = (p, b)
-    dt = time.perf_counter() - t0
-    return HOST_EVENTS / dt
-
+# ----------------------------------------------------------------------
+# host baselines (per-record dict loops; heap-backend analog)
+# ----------------------------------------------------------------------
 
 def bench_host() -> float:
     rng = np.random.default_rng(42)
@@ -183,29 +369,60 @@ def bench_host() -> float:
     return HOST_EVENTS / dt
 
 
+def bench_host_q7() -> float:
+    rng = np.random.default_rng(7)
+    prices = rng.integers(0, 1 << 40, HOST_EVENTS).tolist()
+    bidders = rng.integers(0, 1 << 20, HOST_EVENTS).tolist()
+    panes = rng.integers(0, RING, HOST_EVENTS).tolist()
+    best: dict = {}
+    t0 = time.perf_counter()
+    for p, b, w in zip(prices, bidders, panes):
+        cur = best.get(w)
+        if cur is None or p > cur[0]:
+            best[w] = (p, b)
+    dt = time.perf_counter() - t0
+    return HOST_EVENTS / dt
+
+
+def _line(metric, value, unit, vs):
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, "vs_baseline": round(vs, 2)}))
+
+
 def main() -> None:
-    device_eps = bench_device()
     host_eps = bench_host()
-    print(json.dumps({
-        "metric": "nexmark_q5_hot_items_events_per_sec_1M_keys",
-        "value": round(device_eps, 1),
-        "unit": "events/sec/chip",
-        "vs_baseline": round(device_eps / host_eps, 2),
-    }))
+    eps, p99 = bench_framework_q5(N_KEYS, 1 << 23, CAPACITY)
+    _line("nexmark_q5_framework_events_per_sec_1M_keys", eps,
+          "events/sec/chip", eps / host_eps)
+    return eps, p99, host_eps
 
 
 def suite() -> None:
     """Extended matrix (one JSON line per metric) — `python bench.py
     --suite`. The driver contract stays the single Q5 line in main()."""
-    main()
-    q7 = bench_device_q7()
+    eps, p99, host_eps = main()
+    _line("nexmark_q5_framework_p99_fire_latency_1M_keys", p99, "ms", 1.0)
+
+    eps10, p99_10 = bench_framework_q5(10_000_000, 1 << 25, 1 << 24)
+    _line("nexmark_q5_framework_events_per_sec_10M_keys", eps10,
+          "events/sec/chip", eps10 / host_eps)
+    _line("nexmark_q5_framework_p99_fire_latency_10M_keys", p99_10,
+          "ms", 1.0)
+
     q7_host = bench_host_q7()
-    print(json.dumps({
-        "metric": "nexmark_q7_highest_bid_events_per_sec",
-        "value": round(q7, 1),
-        "unit": "events/sec/chip",
-        "vs_baseline": round(q7 / q7_host, 2),
-    }))
+    q7eps, q7p99 = bench_framework_q7(10_000_000, 1 << 25, 1 << 24)
+    _line("nexmark_q7_framework_events_per_sec_10M_keys", q7eps,
+          "events/sec/chip", q7eps / q7_host)
+    _line("nexmark_q7_framework_p99_fire_latency_10M_keys", q7p99,
+          "ms", 1.0)
+
+    join_eps = bench_framework_q7_join()
+    _line("nexmark_q7_interval_join_events_per_sec", join_eps,
+          "events/sec", join_eps / q7_host)
+
+    kernel = bench_device()
+    _line("q5_kernel_ceiling_events_per_sec_1M_keys", kernel,
+          "events/sec/chip", kernel / host_eps)
 
 
 if __name__ == "__main__":

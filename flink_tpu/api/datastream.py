@@ -561,9 +561,16 @@ class WindowedStream:
     def device_aggregate(self, aggs, capacity: int = 1 << 16,
                          ring_size: int = 64,
                          emit_window_bounds: bool = True,
+                         emit_topk: Optional[int] = None,
+                         defer_overflow: bool = False,
+                         async_fire: bool = False,
                          name: str = "DeviceWindowAgg") -> DataStream:
         """Explicit device window aggregation with multiple AggSpecs
-        (key, [window_start, window_end], *agg columns)."""
+        (key, [window_start, window_end], *agg columns). ``emit_topk=k``
+        emits only the top-k keys by the first aggregate per window (the
+        Nexmark Q5 hot-items fire shape, ranked on device).
+        ``defer_overflow``/``async_fire`` remove all host syncs from the
+        hot path (see DeviceWindowAggOperator)."""
         from ..runtime.operators.device_window import DeviceWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("device aggregation needs a column key")
@@ -574,7 +581,8 @@ class WindowedStream:
             return DeviceWindowAggOperator(
                 assigner, key_col, aggs, capacity=capacity,
                 ring_size=ring_size, emit_window_bounds=emit_window_bounds,
-                name=name)
+                emit_topk=emit_topk, defer_overflow=defer_overflow,
+                async_fire=async_fire, name=name)
 
         par = 1 if self._all else None
         return self.keyed._one_input(name, factory, parallelism=par,

@@ -6,16 +6,31 @@ JobManagerCheckpointStorage): in-memory for tests, filesystem directory
 layout ``<dir>/chk-<id>/metadata`` for durability. Snapshots are
 host-serialized (device state was already DMA'd to numpy by the backends'
 snapshot()).
+
+Incremental checkpoints (VERDICT #5; the RocksDB SST-diff analog,
+RocksIncrementalSnapshotStrategy.java:70 + SharedStateRegistry): device
+keyed snapshots ({"kind": "tpu"}) are re-ordered by key group, split into
+KEY-GROUP PAGES, and stored as content-addressed chunks under
+``<dir>/chunks/``. A page whose key membership and values did not change
+since the previous checkpoint hashes identically and is NOT rewritten —
+checkpoint bytes are O(changed pages), while every checkpoint stays
+logically self-contained (its manifest references the chunks it needs; a
+refcount GC deletes chunks when their last referencing checkpoint is
+subsumed). Savepoints are always written full and inline (user-owned,
+relocatable — reference canonical-format semantics).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import shutil
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+import numpy as np
 
 __all__ = ["CompletedCheckpoint", "CheckpointStorage", "MemoryCheckpointStorage",
            "FsCheckpointStorage"]
@@ -62,33 +77,186 @@ class MemoryCheckpointStorage(CheckpointStorage):
         return self._store[checkpoint_id]
 
 
+class _ChunkRef:
+    """Manifest placeholder for a content-addressed page on disk."""
+
+    __slots__ = ("hash", "dtype", "shape")
+
+    def __init__(self, h: str, dtype: str, shape: tuple):
+        self.hash = h
+        self.dtype = dtype
+        self.shape = shape
+
+
+class _PagedState:
+    """One state's values split into key-group pages of chunk refs,
+    reassembled by concatenation along the last (key) axis."""
+
+    __slots__ = ("pages",)
+
+    def __init__(self, pages: list):
+        self.pages = pages
+
+
+N_PAGES = 16  # key-group space divided into this many dedup pages
+
+
 class FsCheckpointStorage(CheckpointStorage):
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, incremental: bool = True):
         self.directory = directory
-        os.makedirs(directory, exist_ok=True)
+        self.incremental = incremental
+        self.chunk_dir = os.path.join(directory, "chunks")
+        os.makedirs(self.chunk_dir, exist_ok=True)
+        self._refs_path = os.path.join(self.chunk_dir, "_refs.pkl")
+        self._refs: dict[str, set] = self._load_refs()
+        self.last_bytes_written = 0  # chunk + metadata bytes of last store
+
+    def _load_refs(self) -> dict[str, set]:
+        try:
+            with open(self._refs_path, "rb") as f:
+                return pickle.load(f)
+        except (OSError, EOFError):
+            return {}
+
+    def _save_refs(self) -> None:
+        with open(self._refs_path + ".part", "wb") as f:
+            pickle.dump(self._refs, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(self._refs_path + ".part", self._refs_path)
 
     def _path(self, checkpoint: CompletedCheckpoint) -> str:
         prefix = "sp" if checkpoint.is_savepoint else "chk"
         return os.path.join(self.directory, f"{prefix}-{checkpoint.checkpoint_id}")
 
+    # -- chunking ------------------------------------------------------
+    def _write_chunk(self, arr: np.ndarray, ckpt_id: int) -> _ChunkRef:
+        raw = np.ascontiguousarray(arr).tobytes()
+        h = hashlib.blake2b(
+            raw + str((arr.dtype, arr.shape)).encode(),
+            digest_size=20).hexdigest()
+        path = os.path.join(self.chunk_dir, h)
+        if not os.path.exists(path):
+            from ..native import compress
+            payload = compress(raw)
+            with open(path + ".part", "wb") as f:
+                f.write(payload)
+            os.replace(path + ".part", path)
+            self.last_bytes_written += len(payload)
+        self._refs.setdefault(h, set()).add(ckpt_id)
+        return _ChunkRef(h, str(arr.dtype), arr.shape)
+
+    def _read_chunk(self, ref: _ChunkRef,
+                    chunk_dir: Optional[str] = None) -> np.ndarray:
+        with open(os.path.join(chunk_dir or self.chunk_dir, ref.hash),
+                  "rb") as f:
+            from ..native import decompress
+            raw = decompress(f.read())
+        return np.frombuffer(raw, dtype=np.dtype(ref.dtype)).reshape(
+            ref.shape).copy()
+
+    def _page_tpu_snapshot(self, snap: dict, ckpt_id: int) -> dict:
+        """Reorder a device keyed snapshot by key group and replace its
+        value arrays — AND the keys/groups themselves — with
+        key-group-page chunk refs. Page boundaries are fixed spans of the
+        job's max-parallelism key-group space (stable across checkpoints),
+        so a page's bytes only change when one of ITS key groups changed."""
+        keys = np.asarray(snap["keys"])
+        groups = np.asarray(snap["key_groups"])
+        if len(keys) == 0:
+            return snap
+        order = np.lexsort((keys, groups))
+        keys, groups = keys[order], groups[order]
+        mp = int(snap.get("max_parallelism") or (int(groups.max()) + 1))
+        # page boundaries: equal spans of the key-group space
+        bounds = np.searchsorted(
+            groups, np.arange(1, N_PAGES) * ((mp + N_PAGES - 1) // N_PAGES))
+        out = dict(snap)
+        out["keys"] = _PagedState(
+            [self._write_chunk(p, ckpt_id)
+             for p in np.split(keys, bounds)])
+        out["key_groups"] = _PagedState(
+            [self._write_chunk(p, ckpt_id)
+             for p in np.split(groups, bounds)])
+        states = {}
+        for name, sdata in snap["states"].items():
+            vals = np.asarray(sdata["values"])
+            vals = vals[..., order]
+            pages = [self._write_chunk(np.ascontiguousarray(p), ckpt_id)
+                     for p in np.split(vals, bounds, axis=-1)]
+            sd = dict(sdata)
+            sd["values"] = _PagedState(pages)
+            states[name] = sd
+        out["states"] = states
+        return out
+
+    def _resolve(self, obj, chunk_dir: Optional[str] = None):
+        """Recursively materialize chunk refs back into numpy arrays."""
+        if isinstance(obj, _ChunkRef):
+            return self._read_chunk(obj, chunk_dir)
+        if isinstance(obj, _PagedState):
+            parts = [self._read_chunk(r, chunk_dir) for r in obj.pages]
+            parts = [p for p in parts if p.shape[-1]]
+            if not parts:
+                return np.empty(0)
+            return np.concatenate(parts, axis=-1)
+        if isinstance(obj, dict):
+            return {k: self._resolve(v, chunk_dir) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self._resolve(v, chunk_dir) for v in obj]
+        if isinstance(obj, tuple):
+            return tuple(self._resolve(v, chunk_dir) for v in obj)
+        return obj
+
+    def _chunk_snapshots(self, checkpoint: CompletedCheckpoint) -> dict:
+        """Walk task snapshots; page every device keyed snapshot."""
+        def walk(obj):
+            if isinstance(obj, dict):
+                if obj.get("kind") == "tpu" and "keys" in obj:
+                    return self._page_tpu_snapshot(
+                        obj, checkpoint.checkpoint_id)
+                return {k: walk(v) for k, v in obj.items()}
+            if isinstance(obj, list):
+                return [walk(v) for v in obj]
+            if isinstance(obj, tuple):
+                return tuple(walk(v) for v in obj)
+            return obj
+
+        return {tid: walk(s)
+                for tid, s in checkpoint.task_snapshots.items()}
+
+    # -- storage API ---------------------------------------------------
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
         d = self._path(checkpoint)
         os.makedirs(d, exist_ok=True)
         # set the path BEFORE pickling so a checkpoint load()ed from disk
         # knows where it lives
         checkpoint.external_path = d
+        self.last_bytes_written = 0
+        to_write = checkpoint
+        incremental = self.incremental and not checkpoint.is_savepoint
+        if incremental:
+            to_write = CompletedCheckpoint(
+                checkpoint.checkpoint_id, checkpoint.timestamp,
+                self._chunk_snapshots(checkpoint),
+                checkpoint.is_savepoint, checkpoint.external_path,
+                checkpoint.vertex_parallelism, checkpoint.vertex_uids)
         # block-compressed like the reference's snapshot compression
         # (io/compression/BlockCompressionFactory); native LZ4-style codec
         # when built, zlib otherwise — self-describing tag either way
         from ..native import compress
         payload = compress(pickle.dumps(
-            checkpoint, protocol=pickle.HIGHEST_PROTOCOL))
+            to_write, protocol=pickle.HIGHEST_PROTOCOL))
         tmp = os.path.join(d, "_metadata.part")
         with open(tmp, "wb") as f:
             f.write(_COMPRESSED_MAGIC)
             f.write(payload)
         final = os.path.join(d, "_metadata")
         os.replace(tmp, final)  # atomic publish
+        if incremental:
+            # refs persist only AFTER the metadata exists: a crash mid-store
+            # leaves orphan chunk files (re-usable, GC-able) rather than
+            # phantom refs that would pin shared chunks forever
+            self._save_refs()
+        self.last_bytes_written += len(payload)
         return checkpoint
 
     def discard(self, checkpoint: CompletedCheckpoint) -> None:
@@ -96,6 +264,21 @@ class FsCheckpointStorage(CheckpointStorage):
             return  # savepoints are user-owned (reference semantics)
         d = self._path(checkpoint)
         shutil.rmtree(d, ignore_errors=True)
+        # release this checkpoint's chunk references; GC orphans
+        cid = checkpoint.checkpoint_id
+        dead = []
+        for h, refs in self._refs.items():
+            refs.discard(cid)
+            if not refs:
+                dead.append(h)
+        for h in dead:
+            self._refs.pop(h, None)
+            try:
+                os.remove(os.path.join(self.chunk_dir, h))
+            except OSError:
+                pass
+        if dead:
+            self._save_refs()
 
     def load(self, path: str) -> CompletedCheckpoint:
         meta = path if path.endswith("_metadata") else os.path.join(path,
@@ -104,8 +287,17 @@ class FsCheckpointStorage(CheckpointStorage):
             data = f.read()
         if data.startswith(_COMPRESSED_MAGIC):
             from ..native import decompress
-            return pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
-        return pickle.loads(data)  # pre-compression snapshots
+            cp = pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
+        else:
+            cp = pickle.loads(data)  # pre-compression snapshots
+        # chunk refs resolve against the sibling chunks/ dir of wherever
+        # this metadata actually lives (the storage instance may have been
+        # constructed for a different root)
+        chunk_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(meta))),
+            "chunks")
+        cp.task_snapshots = self._resolve(cp.task_snapshots, chunk_dir)
+        return cp
 
 
 _COMPRESSED_MAGIC = b"FTCK"

@@ -25,7 +25,7 @@ plane of the DeviceWindowAggOperator, lifted to N chips.
 from __future__ import annotations
 
 import functools
-from typing import Any, NamedTuple, Sequence
+from typing import Any, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -182,20 +182,33 @@ class ShardedWindowAgg:
         count_name = next(a.name for a in aggs if a.kind == "count")
 
         @jax.jit
-        def fire(state: ShardedWindowState, pane_rows: jax.Array):
-            out = {a.name: AGG_MERGES[a.kind](
-                state.accs[a.name][:, pane_rows, :], axis=1) for a in aggs}
+        def fire(state: ShardedWindowState, pane_rows: jax.Array,
+                 rows_valid: jax.Array):
+            def merge(kind, arr):
+                sub = arr[:, pane_rows, :]              # [D, W, cap]
+                ident = AGG_INITS[kind](arr.dtype)
+                sub = jnp.where(rows_valid[None, :, None], sub, ident)
+                return AGG_MERGES[kind](sub, axis=1)
+
+            out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
             count = out[count_name]
             emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
             return out, emit
 
         return fire
 
-    def fire(self, state: ShardedWindowState, pane_rows: np.ndarray
+    def fire(self, state: ShardedWindowState, pane_rows: np.ndarray,
+             rows_valid: Optional[np.ndarray] = None
              ) -> tuple[dict, jax.Array]:
         """Merge the given ring rows into per-key window results
-        ([D, capacity] per aggregate) + emit mask. Keys = state.table."""
-        return self._fire(state, jnp.asarray(pane_rows, jnp.int32))
+        ([D, capacity] per aggregate) + emit mask. Keys = state.table.
+        Callers firing at a fixed cadence should pad ``pane_rows`` to a
+        constant width and mask with ``rows_valid`` so the program
+        compiles once."""
+        if rows_valid is None:
+            rows_valid = np.ones(len(pane_rows), bool)
+        return self._fire(state, jnp.asarray(pane_rows, jnp.int32),
+                          jnp.asarray(rows_valid))
 
     # ------------------------------------------------------------------
     def _build_retire(self):

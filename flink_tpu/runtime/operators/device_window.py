@@ -24,6 +24,9 @@ re-firing or merging windows.
 
 from __future__ import annotations
 
+import functools
+import time
+from collections import deque
 from functools import partial
 from typing import Any, Optional, Sequence
 
@@ -34,7 +37,6 @@ import numpy as np
 from ...core.elements import Watermark
 from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
 from ...ops.hash_table import EMPTY_KEY
-from ...ops.segment_ops import pane_window_merge
 from ...state.tpu_backend import TpuKeyedStateBackend
 from ...window.assigners import WindowAssigner
 from .base import OneInputOperator, OperatorContext, Output
@@ -56,13 +58,84 @@ class AggSpec:
         self.dtype = dtype
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _masked_topk(values: jax.Array, valid: jax.Array, k: int):
+    """Top-k slots by value among valid slots: (values, slot indices, ok).
+    Entries with ok=False are padding (fewer than k valid slots)."""
+    neg = (jnp.finfo(values.dtype).min
+           if jnp.issubdtype(values.dtype, jnp.floating)
+           else jnp.iinfo(values.dtype).min)
+    masked = jnp.where(valid, values, neg)
+    kk = min(k, values.shape[0])
+    vals, idx = jax.lax.top_k(masked, kk)
+    return vals, idx, jnp.take(valid, idx)
+
+
+@functools.lru_cache(maxsize=128)
+def _fire_program(agg_sig: tuple, topk: Optional[int]):
+    """ONE compiled program per (aggregate signature, top-k) covering the
+    whole fire: masked pane-row merge for every aggregate + emit mask +
+    optional device top-k + health scalars. Module-level and cached so
+    every operator instance with the same shape shares the executable —
+    fire programs must never recompile per instance or per pane count
+    (compiles can cost tens of seconds when the chip sits behind a
+    tunnel). ``pane_rows`` is therefore PADDED to the window width with a
+    validity mask instead of varying in shape."""
+    from ...ops.segment_ops import AGG_INITS, AGG_MERGES
+
+    @jax.jit
+    def fire_fn(table, arrays, pane_rows, rows_valid, dropped):
+        def merge(kind, arr):
+            sub = arr[pane_rows]                        # [W, cap]
+            ident = AGG_INITS[kind](arr.dtype)
+            sub = jnp.where(rows_valid[:, None], sub, ident)
+            return AGG_MERGES[kind](sub, axis=0)
+
+        count = merge("count", arrays["__count__"])
+        emit = (table != jnp.int64(EMPTY_KEY)) & (count > 0)
+        results = {}
+        for kind, out_name in agg_sig:
+            if kind == "count":
+                results[out_name] = count
+            elif kind == "avg":
+                s = merge("sum", arrays[f"{out_name}.sum"])
+                results[out_name] = s / jnp.maximum(count, 1).astype(s.dtype)
+            else:
+                results[out_name] = merge(kind, arrays[out_name])
+        occ = (table != jnp.int64(EMPTY_KEY)).sum()
+        if topk is not None:
+            ranked = results[agg_sig[0][1]]
+            _vals, idx, ok = _masked_topk(ranked, emit, topk)
+            keys = jnp.take(table, idx)
+            out = {n: jnp.take(r, idx) for n, r in results.items()}
+            return keys, ok, out, dropped, occ
+        return table, emit, results, dropped, occ
+
+    return fire_fn
+
+
 class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
     def __init__(self, assigner: WindowAssigner, key_column: str,
                  aggs: Sequence[AggSpec],
                  capacity: int = 1 << 16,
                  ring_size: int = 64,
                  emit_window_bounds: bool = True,
+                 emit_topk: Optional[int] = None,
+                 defer_overflow: bool = False,
+                 async_fire: bool = False,
+                 hbm_budget_slots: int = 0,
                  name: str = "DeviceWindowAgg"):
+        """``emit_topk``: emit only the k keys with the largest value of the
+        FIRST aggregate per window (one device lax.top_k instead of a full
+        [capacity] host materialization) — the Nexmark Q5 hot-items /
+        ORDER BY ... LIMIT k fire shape.
+
+        ``defer_overflow``: never sync the hot path with the host; hash
+        overflow accumulates in a device counter checked at fire time.
+        ``async_fire``: fire programs emit asynchronously — results are
+        drained once their device->host copy lands, and watermarks are
+        held behind their fires. Both default off (fully synchronous
+        semantics); the benchmark/production path enables both."""
         super().__init__(name)
         pane = assigner.pane_size
         if pane is None:
@@ -81,16 +154,29 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         self._aggs = list(aggs)
         self._capacity = capacity
         self._emit_bounds = emit_window_bounds
+        self._topk = emit_topk
+        self._defer = bool(defer_overflow)
+        self._async = bool(async_fire)
+        self._hbm_budget = int(hbm_budget_slots)
 
         self._backend: Optional[TpuKeyedStateBackend] = None
         self._init_control_plane()
+        if self._async:
+            self._record_fire_latency = False
+        self._pending: deque = deque()
+        self._fire_fn = None
         self._out_schema: Optional[Schema] = None
 
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         super().setup(ctx, output)
+        from ...core.config import StateOptions
+        budget = self._hbm_budget or ctx.config.get(
+            StateOptions.TPU_HBM_BUDGET)
         self._backend = TpuKeyedStateBackend(
-            ctx.key_group_range, ctx.max_parallelism, capacity=self._capacity)
+            ctx.key_group_range, ctx.max_parallelism,
+            capacity=self._capacity, defer_overflow=self._defer,
+            hbm_budget_slots=budget)
         self._backend.register_array_state("__count__", "count", jnp.int64,
                                            ring=self._ring)
         self._registered = False
@@ -127,6 +213,8 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
 
     # -- data path ---------------------------------------------------------
     def process_batch(self, batch: RecordBatch) -> None:
+        if self._pending:
+            self._drain(block=False)
         if batch.n == 0:
             return
         if not self._registered:
@@ -143,21 +231,67 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
 
     def _fold(self, batch: RecordBatch, keys: np.ndarray,
               panes: np.ndarray) -> None:
+        if self._defer:
+            # pipelined path: host<->device calls have a large fixed cost
+            # (the chip may sit behind a network tunnel), so the whole
+            # batch rides ONE upload and nothing syncs back
+            self._fold_packed(batch, keys, panes % self._ring)
+            return
+        ring_idx = panes % self._ring
         slots = self._backend.slots_for_batch(keys)
-        ring_idx = jnp.asarray(panes % self._ring)
         valid = slots >= 0
         self._backend.fold_batch("__count__", slots,
-                                 jnp.ones(batch.n, jnp.int64), valid,
+                                 np.ones(batch.n, np.int64), valid,
                                  ring_idx=ring_idx)
         for a in self._aggs:
             if a.kind == "count":
                 continue
-            col = jnp.asarray(batch.column(a.field))
+            col = batch.column(a.field)
             name = f"{a.out_name}.sum" if a.kind == "avg" else a.out_name
             self._backend.fold_batch(name, slots, col, valid,
                                      ring_idx=ring_idx)
 
+    def _fold_packed(self, batch: RecordBatch, keys: np.ndarray,
+                     ring_idx: np.ndarray) -> None:
+        """Pack keys + ring rows + every aggregate column into one [C, B]
+        int64 buffer (floats bit-cast via float64), upload once, slice on
+        device. Zero host round-trips per batch."""
+        rows = [keys, ring_idx]
+        col_meta: list[tuple[str, bool]] = []
+        for a in self._aggs:
+            if a.kind == "count":
+                continue
+            col = np.asarray(batch.column(a.field))
+            name = f"{a.out_name}.sum" if a.kind == "avg" else a.out_name
+            if np.issubdtype(col.dtype, np.floating):
+                rows.append(np.ascontiguousarray(
+                    col.astype(np.float64)).view(np.int64))
+                col_meta.append((name, True))
+            else:
+                rows.append(col.astype(np.int64))
+                col_meta.append((name, False))
+        buf = jnp.asarray(np.stack(rows))          # the ONE upload
+        slots = self._backend.slots_for_batch_device(buf[0])
+        dring = buf[1]
+        valid = slots >= 0
+        self._backend.fold_batch("__count__", slots,
+                                 jnp.ones(batch.n, jnp.int64), valid,
+                                 ring_idx=dring)
+        for i, (name, is_float) in enumerate(col_meta):
+            vals = buf[2 + i]
+            if is_float:
+                vals = jax.lax.bitcast_convert_type(vals, jnp.float64)
+            self._backend.fold_batch(name, slots, vals, valid,
+                                     ring_idx=dring)
+
     # -- firing (fire loop lives in SliceControlPlane) ----------------------
+    # A fire is ONE compiled program (pane merge for every aggregate +
+    # emit mask + optional device top-k + health scalars) whose outputs
+    # start copying device->host asynchronously at dispatch. In async mode
+    # the emission is queued and drained once the copy lands — fires cost
+    # no synchronous round-trip, and the watermark is held behind its
+    # fires so it never overtakes them downstream.
+
     def _fire(self, p_end: int) -> None:
         W = self._window_panes
         # never read panes below min_seen: they hold no data and their ring
@@ -165,56 +299,149 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         first = max(p_end - W, self._min_seen_pane)
         if first >= p_end:
             return
-        pane_rows = np.array([(p % self._ring) for p in range(first, p_end)],
-                             dtype=np.int32)
-        rows_d = jnp.asarray(pane_rows)
-        count = pane_window_merge("count", self._backend.get_array("__count__"),
-                                  rows_d)
-        emit_mask = (self._backend.occupied_mask()) & (count > 0)
-        results = {}
-        for a in self._aggs:
-            if a.kind == "count":
-                results[a.out_name] = count
-            elif a.kind == "avg":
-                s = pane_window_merge(
-                    "sum", self._backend.get_array(f"{a.out_name}.sum"), rows_d)
-                results[a.out_name] = s / jnp.maximum(count, 1).astype(s.dtype)
-            else:
-                results[a.out_name] = pane_window_merge(
-                    a.kind, self._backend.get_array(a.out_name), rows_d)
-
-        self._emit(p_end, emit_mask, results)
-
+        rows = [(p % self._ring) for p in range(first, p_end)]
+        # constant [W] shape: pad + mask so every fire shares one program
+        pane_rows = np.zeros(W, np.int32)
+        pane_rows[:len(rows)] = rows
+        rows_valid = np.zeros(W, bool)
+        rows_valid[:len(rows)] = True
+        fire_fn = _fire_program(
+            tuple((a.kind, a.out_name) for a in self._aggs), self._topk)
+        arrays = {n: self._backend.get_array(n)
+                  for n in self._fire_array_names()}
+        outs = fire_fn(self._backend.table, arrays,
+                       jnp.asarray(pane_rows), jnp.asarray(rows_valid),
+                       self._backend.dropped_device)
+        for leaf in jax.tree_util.tree_leaves(outs):
+            leaf.copy_to_host_async()
+        # the host spill tier's rows merge at materialization; take them
+        # NOW (before this fire retires the pane row below)
+        host_part = (self._host_fire_part(np.array(rows, np.int32))
+                     if self._backend.spill_active else None)
+        item = (p_end, outs, host_part, time.perf_counter())
+        if self._async:
+            self._pending.append(item)
+        else:
+            self._materialize(item)
         # retire the oldest pane of this window: no future window needs it
         # (skip panes below min_seen — their ring rows belong to live panes)
         if p_end - W >= self._min_seen_pane:
             self._backend.reset_ring_row((p_end - W) % self._ring)
 
-    def _emit(self, p_end: int, emit_mask: jax.Array,
-              results: dict[str, jax.Array]) -> None:
-        mask = np.asarray(jax.device_get(emit_mask))
+    def _fire_array_names(self) -> list[str]:
+        names = ["__count__"]
+        for a in self._aggs:
+            if a.kind == "count":
+                continue
+            names.append(f"{a.out_name}.sum" if a.kind == "avg"
+                         else a.out_name)
+        return names
+
+    def _host_fire_part(self, pane_rows: np.ndarray):
+        """Window results for spilled keys (numpy merges over the host
+        tier's ring rows)."""
+        ht = self._backend.host_tier
+        hcount = ht.fire("__count__", pane_rows)
+        mask = hcount > 0
         if not mask.any():
+            return None
+        keys = ht.keys()[mask]
+        res: dict[str, np.ndarray] = {}
+        for a in self._aggs:
+            if a.kind == "count":
+                res[a.out_name] = hcount[mask]
+            elif a.kind == "avg":
+                s = ht.fire(f"{a.out_name}.sum", pane_rows)[mask]
+                res[a.out_name] = s / np.maximum(hcount[mask],
+                                                 1).astype(s.dtype)
+            else:
+                res[a.out_name] = ht.fire(a.out_name, pane_rows)[mask]
+        return keys, res
+
+    def _materialize(self, item) -> None:
+        p_end, outs, host_part, t0 = item
+        host = jax.device_get(outs)       # ONE transfer for everything
+        if self._topk is not None:
+            keys_k, ok, results, dropped, occ = host
+            self._backend.apply_health(dropped, occ)
+            sel = np.asarray(ok)
+            keys = np.asarray(keys_k)[sel]
+            results = {n: np.asarray(v)[sel] for n, v in results.items()}
+        else:
+            table, emit, results, dropped, occ = host
+            self._backend.apply_health(dropped, occ)
+            mask = np.asarray(emit)
+            idx = np.flatnonzero(mask)
+            keys = np.asarray(table)[idx]
+            results = {n: np.asarray(v)[idx] for n, v in results.items()}
+        if host_part is not None:
+            hkeys, hres = host_part
+            keys = np.concatenate([keys, hkeys])
+            results = {n: np.concatenate(
+                [v, hres[n].astype(v.dtype, copy=False)])
+                for n, v in results.items()}
+            if self._topk is not None and len(keys) > self._topk:
+                order = np.argsort(
+                    -results[self._aggs[0].out_name],
+                    kind="stable")[:self._topk]
+                keys = keys[order]
+                results = {n: v[order] for n, v in results.items()}
+        if len(keys) == 0:
+            self._note_latency(t0)
             return
-        idx = np.flatnonzero(mask)
-        table = np.asarray(jax.device_get(self._backend.table))
-        keys = table[idx]
+        self._emit_rows(p_end, keys, results)
+        self._note_latency(t0)
+
+    def _note_latency(self, t0: float) -> None:
+        from .slice_control import _MAX_FIRE_SAMPLES
+        if self._async and len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES:
+            self.fire_latencies_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def _emit_rows(self, p_end: int, keys: np.ndarray,
+                   results: dict[str, np.ndarray]) -> None:
+        n = len(keys)
         start = (p_end - self._window_panes) * self._pane + self._offset
         end = p_end * self._pane + self._offset
         cols: dict[str, np.ndarray] = {self._key_column: keys}
         fields: list[tuple[str, Any]] = [(self._key_column, np.int64)]
         if self._emit_bounds:
-            cols["window_start"] = np.full(len(idx), start, np.int64)
-            cols["window_end"] = np.full(len(idx), end, np.int64)
+            cols["window_start"] = np.full(n, start, np.int64)
+            cols["window_end"] = np.full(n, end, np.int64)
             fields += [("window_start", np.int64), ("window_end", np.int64)]
-        for name, arr in results.items():
-            vals = np.asarray(jax.device_get(arr))[idx]
+        for name, vals in results.items():
             cols[name] = vals
             fields.append((name, vals.dtype.type))
         schema = Schema(fields)
-        ts = np.full(len(idx), end - 1, np.int64)
+        ts = np.full(n, end - 1, np.int64)
         self.output.emit(RecordBatch(schema, cols, ts))
+
+    # -- async emission queue ----------------------------------------------
+    def _drain(self, block: bool = False) -> None:
+        while self._pending:
+            head = self._pending[0]
+            if isinstance(head, Watermark):
+                self.output.emit_watermark(head)
+                self._pending.popleft()
+                continue
+            _p_end, outs, _hp, _t0 = head
+            if not block and not all(
+                    leaf.is_ready()
+                    for leaf in jax.tree_util.tree_leaves(outs)):
+                return
+            self._pending.popleft()
+            self._materialize(head)
+
+    def _emit_watermark_out(self, watermark: Watermark) -> None:
+        if self._async and self._pending:
+            self._pending.append(watermark)
+        else:
+            self.output.emit_watermark(watermark)
+
+    def finish(self) -> None:
+        self._drain(block=True)
 
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:
+        self._drain(block=True)
         return {"keyed": {"backend": self._backend.snapshot(checkpoint_id),
                           "meta": self._control_meta()}}

@@ -262,9 +262,13 @@ class MeshWindowAggOperator(SliceControlPlane, OneInputOperator):
         first = max(p_end - W, self._min_seen_pane)
         if first >= p_end:
             return
-        pane_rows = np.array([(p % self._ring) for p in range(first, p_end)],
-                             dtype=np.int32)
-        results, emit = self._agg.fire(self._state, pane_rows)
+        rows = [(p % self._ring) for p in range(first, p_end)]
+        # constant [W] shape so the fire program compiles once
+        pane_rows = np.zeros(W, np.int32)
+        pane_rows[:len(rows)] = rows
+        rows_valid = np.zeros(W, bool)
+        rows_valid[:len(rows)] = True
+        results, emit = self._agg.fire(self._state, pane_rows, rows_valid)
         self._emit(p_end, results, emit)
         # retire the oldest pane of this window: no future window needs it
         if p_end - W >= self._min_seen_pane:
@@ -339,7 +343,7 @@ class MeshWindowAggOperator(SliceControlPlane, OneInputOperator):
                               "dtype": str(np.dtype(a.dtype)),
                               "ring": self._ring, "values": vals}
         return {"kind": "tpu", "keys": keys, "key_groups": groups,
-                "states": states}
+                "max_parallelism": self._max_parallelism, "states": states}
 
     def snapshot_state(self, checkpoint_id: int) -> dict:
         self._flush(pad=True)

@@ -48,8 +48,11 @@ class SliceControlPlane:
         self._max_seen_pane: Optional[int] = None
         self._late_dropped = 0
         # wall-clock of each window fire (merge + emit), for the p99
-        # window-fire latency metric (BASELINE.md); bounded reservoir
+        # window-fire latency metric (BASELINE.md); bounded reservoir.
+        # Async-firing operators set _record_fire_latency False and record
+        # dispatch->drain themselves.
         self.fire_latencies_ms: list[float] = []
+        self._record_fire_latency = True
 
     # -- metadata ----------------------------------------------------------
     def _control_meta(self) -> dict:
@@ -122,7 +125,8 @@ class SliceControlPlane:
             for p_end in range(start, last + 1):
                 t0 = time.perf_counter()
                 self._fire(p_end)
-                if len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES:
+                if (self._record_fire_latency
+                        and len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES):
                     self.fire_latencies_ms.append(
                         (time.perf_counter() - t0) * 1e3)
         # the boundary tracks the watermark even when no data has arrived
@@ -131,6 +135,11 @@ class SliceControlPlane:
         if (self._fired_boundary is None
                 or wm_pane_end + 1 > self._fired_boundary):
             self._fired_boundary = wm_pane_end + 1
+        self._emit_watermark_out(watermark)
+
+    def _emit_watermark_out(self, watermark: Watermark) -> None:
+        """Hook: async-firing operators hold the watermark behind its
+        fires' pending emissions so it never overtakes them downstream."""
         self.output.emit_watermark(watermark)
 
     def _pre_fire_flush(self) -> None:

@@ -38,6 +38,7 @@ from ..ops.hash_table import (
 from ..ops.segment_ops import AGG_INITS, make_accumulator, scatter_fold
 from .backend import KeyedStateBackend, State, ValueState, register_backend
 from .descriptors import StateDescriptor
+from .spill import HostTier
 
 __all__ = ["TpuKeyedStateBackend"]
 
@@ -63,7 +64,9 @@ class _ArrayState:
 
 class TpuKeyedStateBackend(KeyedStateBackend):
     def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
-                 capacity: int = 1 << 16, config=None, **_kw):
+                 capacity: int = 1 << 16, config=None,
+                 defer_overflow: bool = False,
+                 hbm_budget_slots: int = 0, **_kw):
         super().__init__(key_group_range, max_parallelism)
         cap = 1
         while cap < capacity:
@@ -73,28 +76,93 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         self._array_states: dict[str, _ArrayState] = {}
         self._row_states: dict[str, State] = {}
         self._num_keys = 0  # host-tracked occupancy (exact: insert-only table)
+        # deferred mode: the hot path never syncs with the host; overflow
+        # accumulates in a device counter checked at watermark boundaries
+        self._defer = bool(defer_overflow)
+        self._dropped = jnp.zeros((), jnp.int64)
+        # spill tier: device capacity is capped at the HBM budget; cold key
+        # groups page out to host RAM (state/spill.py). 0 = unlimited.
+        if hbm_budget_slots and defer_overflow:
+            raise ValueError("hbm_budget_slots and defer_overflow are "
+                             "mutually exclusive (spill routing needs the "
+                             "per-batch key-group split)")
+        budget = 0
+        if hbm_budget_slots:
+            budget = 1
+            while budget * 2 <= hbm_budget_slots:
+                budget <<= 1
+            if cap > budget:
+                # the budget wins: start at the cap the device may use
+                cap = budget
+                self.capacity = cap
+                self.table = make_table(cap)
+        self._budget = budget
+        self._host: Optional[HostTier] = None
+        self._last_touch = np.zeros(max_parallelism, np.int64)
+        self._batch_no = 0
+        self._pending_host: Optional[tuple[np.ndarray, np.ndarray]] = None
 
     # ------------------------------------------------------------------
     # hot path: batched slot resolution + scatter folds
     # ------------------------------------------------------------------
     def slots_for_batch(self, keys: np.ndarray) -> jax.Array:
-        """Lookup-or-insert a batch of int64 keys; grows (rehash) on
-        overflow. Returns device int32 slots."""
+        """Lookup-or-insert a batch of int64 keys. In the default
+        (synchronous) mode the table grows by rehash on overflow, at the
+        cost of one host sync per batch. In deferred mode (the pipelined
+        bench/production path) there is NO sync: failed inserts return
+        negative slots (the fold skips them), a device drop counter
+        accumulates, and ``check_health`` at the next watermark raises /
+        grows. Returns device int32 slots."""
         keys = _sanitize_keys(np.asarray(keys))
+        if self._defer:
+            return self.slots_for_batch_device(jnp.asarray(keys))
+        self._pending_host = None
+        groups = None
+        if self._budget:
+            self._batch_no += 1
+            groups = key_groups_for_hash_batch(hash_batch(keys),
+                                               self.max_parallelism)
+            self._last_touch[groups] = self._batch_no
         dkeys = jnp.asarray(keys)
         while True:
-            new_table, slots, ok = lookup_or_insert(self.table, dkeys)
+            # keep the device call's shapes CONSTANT across batches (one
+            # compiled executable): spilled rows ride along masked invalid
+            # instead of being sliced out
+            if (self._host is not None and self._host.active
+                    and groups is not None):
+                sp = self._host.spilled_mask[groups]
+                if not sp.any():
+                    sp = None
+            else:
+                sp = None
+            dvalid = None if sp is None else jnp.asarray(~sp)
+            new_table, slots, ok = lookup_or_insert(self.table, dkeys,
+                                                    dvalid)
+            ok_all = ok.all() if sp is None else (ok | jnp.asarray(sp)).all()
             all_ok, occupancy = jax.device_get(
-                (ok.all(), (new_table != EMPTY_KEY).sum()))
+                (ok_all, (new_table != EMPTY_KEY).sum()))
             if bool(all_ok):
                 self.table = new_table
                 self._num_keys = int(occupancy)
                 if self._num_keys > 0.6 * self.capacity:
-                    self._rehash(self.capacity * 2)
-                    # slots computed against the pre-rehash table are stale
-                    slots = lookup(self.table, dkeys)
-                return slots
-            self._rehash(self.capacity * 2)
+                    if not self._budget or 2 * self.capacity <= self._budget:
+                        self._rehash(self.capacity * 2)
+                        # slots against the pre-rehash table are stale
+                        slots = lookup(self.table, dkeys)
+                    else:
+                        self._evict_cold_groups(batch_groups=groups)
+                        continue  # spilled set changed; re-split the batch
+                break
+            if not self._budget or 2 * self.capacity <= self._budget:
+                self._rehash(self.capacity * 2)
+            else:
+                self._evict_cold_groups(batch_groups=groups)
+        if sp is not None:
+            host_pos = np.flatnonzero(sp)
+            hslots = self._host.slots_for(keys[host_pos])
+            self._host.host_folds += 1
+            self._pending_host = (host_pos, hslots)
+        return slots
 
     def _rehash(self, new_capacity: int) -> None:
         """Grow the table and remap every array state on device."""
@@ -102,30 +170,114 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         occupied = jax.device_get(old_table != EMPTY_KEY)
         old_keys = jax.device_get(old_table)[occupied]
         old_slots = np.flatnonzero(occupied).astype(np.int32)
+        self._rebuild_device(old_keys, old_slots, new_capacity)
 
+    def _rebuild_device(self, keep_keys: np.ndarray,
+                        old_slots: np.ndarray, new_capacity: int) -> None:
+        """Re-key the device table to ``keep_keys`` only (rehash growth or
+        post-eviction shrink of the resident set), remapping every array
+        state's rows on device."""
+        old_arrays = {n: st.array for n, st in self._array_states.items()}
         new_table = make_table(new_capacity)
-        new_table, new_slots, ok = lookup_or_insert(
-            new_table, jnp.asarray(old_keys))
-        if not bool(jax.device_get(ok.all())):  # pragma: no cover
-            raise RuntimeError("rehash failed: pathological key distribution")
+        if len(keep_keys):
+            new_table, new_slots, ok = lookup_or_insert(
+                new_table, jnp.asarray(keep_keys))
+            if not bool(jax.device_get(ok.all())):  # pragma: no cover
+                raise RuntimeError(
+                    "rebuild failed: pathological key distribution")
         self.table = new_table
         self.capacity = new_capacity
-        for st in self._array_states.values():
+        self._num_keys = len(keep_keys)
+        for name, st in self._array_states.items():
             shape = ((st.ring, new_capacity) if st.ring else (new_capacity,))
             new_arr = make_accumulator(st.kind, shape, st.dtype)
-            if st.ring:
-                new_arr = new_arr.at[:, new_slots].set(
-                    st.array[:, jnp.asarray(old_slots)])
-            else:
-                new_arr = new_arr.at[new_slots].set(
-                    st.array[jnp.asarray(old_slots)])
+            if len(keep_keys):
+                if st.ring:
+                    new_arr = new_arr.at[:, new_slots].set(
+                        old_arrays[name][:, jnp.asarray(old_slots)])
+                else:
+                    new_arr = new_arr.at[new_slots].set(
+                        old_arrays[name][jnp.asarray(old_slots)])
             st.array = new_arr
+
+    # ------------------------------------------------------------------
+    # spill tier (HBM budget; state/spill.py)
+    # ------------------------------------------------------------------
+    @property
+    def spill_active(self) -> bool:
+        return self._host is not None and self._host.active
+
+    @property
+    def host_tier(self) -> Optional[HostTier]:
+        return self._host
+
+    def _evict_cold_groups(self, rebuild_capacity: Optional[int] = None,
+                           batch_groups: Optional[np.ndarray] = None
+                           ) -> None:
+        """Page the coldest resident key groups to the host tier and
+        rebuild the device table without them — the unit of movement is
+        the key group (KeyGroupRangeAssignment.java:63), LRU by the last
+        batch that touched the group. When the resident set alone cannot
+        make room (e.g. one batch introduces more new keys than the whole
+        budget), groups OF THE INCOMING BATCH are marked spilled too —
+        each call spills at least one, so the caller's retry loop always
+        terminates."""
+        if self._host is None:
+            self._host = HostTier(self.max_parallelism)
+        for name, st in self._array_states.items():
+            self._host.register(name, st.kind, np.dtype(jnp.dtype(st.dtype)),
+                                st.ring)
+        cap = rebuild_capacity or self.capacity
+        t = np.asarray(jax.device_get(self.table))
+        occupied = t != np.int64(EMPTY_KEY)
+        keys_dev = t[occupied]
+        slots_dev = np.flatnonzero(occupied).astype(np.int32)
+        groups_dev = key_groups_for_hash_batch(hash_batch(keys_dev),
+                                               self.max_parallelism)
+        counts = np.bincount(groups_dev, minlength=self.max_parallelism)
+        resident = np.flatnonzero(counts > 0)
+        order = resident[np.argsort(self._last_touch[resident],
+                                    kind="stable")]
+        target = int(0.4 * cap)
+        need = max(len(keys_dev) - target, max(1, len(keys_dev) // 4))
+        evict_groups, acc = [], 0
+        for g in order:
+            evict_groups.append(int(g))
+            acc += int(counts[g])
+            if acc >= need:
+                break
+        if acc < need and batch_groups is not None:
+            # resident set can't make room: spill half the incoming
+            # batch's (not yet spilled) groups as well
+            fresh = np.unique(batch_groups)
+            fresh = fresh[~self._host.spilled_mask[fresh]]
+            fresh = [int(g) for g in fresh if g not in set(evict_groups)]
+            evict_groups.extend(fresh[:max(1, len(fresh) // 2)])
+        if not evict_groups:
+            raise RuntimeError(
+                "spill eviction made no progress; raise the HBM budget")
+        gmask = np.zeros(self.max_parallelism, bool)
+        gmask[evict_groups] = True
+        sel = gmask[groups_dev]
+        ev_slots = slots_dev[sel]
+        if sel.any():
+            values = {}
+            for name, st in self._array_states.items():
+                arr = np.asarray(jax.device_get(st.array))
+                values[name] = (arr[:, ev_slots] if st.ring
+                                else arr[ev_slots])
+            self._host.absorb(keys_dev[sel], values)
+        self._host.spilled_mask[evict_groups] = True
+        self._rebuild_device(keys_dev[~sel], slots_dev[~sel], cap)
 
     def register_array_state(self, name: str, kind: str, dtype,
                              ring: Optional[int] = None) -> None:
         if name not in self._array_states:
             self._array_states[name] = _ArrayState(name, kind, dtype, ring,
                                                    self.capacity)
+            if self._host is not None:
+                self._host.register(name, kind,
+                                    np.dtype(jnp.dtype(dtype)), ring)
 
     def get_array(self, name: str) -> jax.Array:
         return self._array_states[name].array
@@ -133,18 +285,36 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def set_array(self, name: str, array: jax.Array) -> None:
         self._array_states[name].array = array
 
-    def fold_batch(self, name: str, slots: jax.Array, values: jax.Array,
+    def fold_batch(self, name: str, slots: jax.Array, values,
                    valid: jax.Array,
-                   ring_idx: Optional[jax.Array] = None) -> None:
-        """acc[(ring_idx,) slot] op= values — one scatter per aggregate."""
+                   ring_idx=None) -> None:
+        """acc[(ring_idx,) slot] op= values — one scatter per aggregate.
+        ``values``/``ring_idx`` may be numpy (preferred when a spill tier
+        is configured: the host-side rows of the batch fold into the host
+        mirror without a device round-trip)."""
         st = self._array_states[name]
+        dvals = values if isinstance(values, jax.Array) else \
+            jnp.asarray(values)
         if st.ring:
-            flat = ring_idx.astype(jnp.int32) * st.array.shape[1] + slots
+            dring = (ring_idx if isinstance(ring_idx, jax.Array)
+                     else jnp.asarray(ring_idx))
+            flat = dring.astype(jnp.int32) * st.array.shape[1] + slots
             folded = scatter_fold(st.kind, st.array.reshape(-1), flat,
-                                  values, valid)
+                                  dvals, valid)
             st.array = folded.reshape(st.array.shape)
         else:
-            st.array = scatter_fold(st.kind, st.array, slots, values, valid)
+            st.array = scatter_fold(st.kind, st.array, slots, dvals, valid)
+        if self._pending_host is not None:
+            pos, hslots = self._pending_host
+            vals_np = (np.asarray(jax.device_get(values))
+                       if isinstance(values, jax.Array)
+                       else np.asarray(values))
+            ring_np = None
+            if st.ring is not None and ring_idx is not None:
+                ring_np = (np.asarray(jax.device_get(ring_idx))
+                           if isinstance(ring_idx, jax.Array)
+                           else np.asarray(ring_idx))[pos]
+            self._host.fold(name, hslots, vals_np[pos], ring_np)
 
     def reset_ring_row(self, row: int) -> None:
         """Zero one ring row of every ring-shaped array state back to its
@@ -153,6 +323,48 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             if st.ring:
                 st.array = st.array.at[row].set(
                     AGG_INITS[st.kind](st.array.dtype))
+        if self._host is not None:
+            self._host.reset_ring_row(row)
+
+    def slots_for_batch_device(self, dkeys: jax.Array) -> jax.Array:
+        """Deferred-mode hot path for keys ALREADY on device (one packed
+        upload per batch; see DeviceWindowAggOperator._fold_packed): pure
+        dispatch, no host sync, sentinel keys remapped on device."""
+        if not self._defer:
+            raise RuntimeError("device-resident slot resolution requires "
+                               "defer_overflow mode")
+        dkeys = jnp.where(dkeys == jnp.int64(EMPTY_KEY),
+                          jnp.int64(EMPTY_KEY) - 1, dkeys)
+        self.table, slots, ok = lookup_or_insert(self.table, dkeys)
+        self._dropped = self._dropped + jnp.sum(~ok).astype(jnp.int64)
+        return slots
+
+    # ------------------------------------------------------------------
+    # deferred-mode health (device scalars; ride along with fire programs)
+    # ------------------------------------------------------------------
+    @property
+    def dropped_device(self) -> jax.Array:
+        return self._dropped
+
+    def apply_health(self, dropped: int, occupancy: int) -> None:
+        """Consume host-materialized health scalars (fetched in the same
+        device_get as a fire's results): hard-error on any dropped insert,
+        grow the table before the load factor bites."""
+        if int(dropped) > 0:
+            raise RuntimeError(
+                f"device hash table overflow: {int(dropped)} records "
+                f"dropped (capacity {self.capacity}); raise "
+                "state.backend.tpu.slots-per-key-group or disable "
+                "deferred overflow checking")
+        self._num_keys = int(occupancy)
+        if self._num_keys > 0.6 * self.capacity:
+            self._rehash(self.capacity * 2)
+
+    def check_health(self) -> None:
+        """Standalone (blocking) variant of apply_health."""
+        d, occ = jax.device_get((self._dropped,
+                                 (self.table != EMPTY_KEY).sum()))
+        self.apply_health(int(d), int(occ))
 
     def conform_ring(self, ring: int, live_panes: Iterable[int]) -> None:
         """Re-seat ring-shaped array states restored under a DIFFERENT ring
@@ -217,14 +429,23 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         # into exactly the key-group ranges the exchange routes them to
         groups = key_groups_for_hash_batch(hash_batch(keys),
                                            self.max_parallelism)
+        host_keys = host_vals = None
+        if self._host is not None and len(self._host.index):
+            host_keys, host_vals = self._host.snapshot_parts()
+            keys = np.concatenate([keys, host_keys])
+            groups = np.concatenate([groups, key_groups_for_hash_batch(
+                hash_batch(host_keys), self.max_parallelism)])
         states = {}
         for name, st in self._array_states.items():
             arr = jax.device_get(st.array)
             vals = arr[:, slots] if st.ring else arr[slots]
+            if host_vals is not None:
+                vals = np.concatenate(
+                    [vals, host_vals[name].astype(vals.dtype)], axis=-1)
             states[name] = {"kind": st.kind, "dtype": str(np.dtype(st.dtype)),
                             "ring": st.ring, "values": vals}
         return {"kind": "tpu", "keys": keys, "key_groups": groups,
-                "states": states}
+                "max_parallelism": self.max_parallelism, "states": states}
 
     def restore(self, snapshots: Iterable[dict]) -> None:
         all_keys, per_state_vals = [], {}
@@ -243,7 +464,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         keys = (np.concatenate(all_keys) if all_keys
                 else np.empty(0, np.int64))
         while self.capacity < 2 * max(len(keys), 1):
-            self.capacity *= 2
+            self.capacity *= 2  # may exceed the budget; evicted back below
         self.table = make_table(self.capacity)
         self._num_keys = len(keys)
         if len(keys):
@@ -264,6 +485,11 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 else:
                     st.array = st.array.at[slots].set(jnp.asarray(vals))
             self._array_states[name] = st
+        # restored state may exceed the HBM budget: page the overflow out
+        # immediately (fresh LRU; group order decides coldness)
+        self._host = None
+        if self._budget and self.capacity > self._budget:
+            self._evict_cold_groups(rebuild_capacity=self._budget)
 
 
 class _TpuValueState(ValueState):
