@@ -78,7 +78,9 @@ class MemoryCheckpointStorage(CheckpointStorage):
 
 
 class _ChunkRef:
-    """Manifest placeholder for a content-addressed page on disk."""
+    """Manifest placeholder for a content-addressed page on disk
+    (legacy format — still readable; new manifests use _PagedState's
+    compact digest list)."""
 
     __slots__ = ("hash", "dtype", "shape")
 
@@ -89,13 +91,24 @@ class _ChunkRef:
 
 
 class _PagedState:
-    """One state's values split into key-group pages of chunk refs,
-    reassembled by concatenation along the last (key) axis."""
+    """One state's values split into key-group pages, reassembled by
+    concatenation along the last (key) axis.
 
-    __slots__ = ("pages",)
+    Manifest cost is what makes an *unchanged* checkpoint cheap, so the
+    per-page record is a bare 16-byte content digest; dtype and leading
+    shape are stored once here and each page's last-axis length is
+    derived from its decompressed byte count."""
 
-    def __init__(self, pages: list):
-        self.pages = pages
+    __slots__ = ("pages", "dtype", "lead_shape")
+
+    def __init__(self, pages: list, dtype: str = None, lead_shape: tuple = None):
+        self.pages = pages          # list[bytes] digests (or legacy _ChunkRef)
+        self.dtype = dtype
+        self.lead_shape = lead_shape
+
+    def __reduce__(self):
+        return (_PagedState, (self.pages, getattr(self, "dtype", None),
+                              getattr(self, "lead_shape", None)))
 
 
 N_PAGES = 16  # key-group space divided into this many dedup pages
@@ -128,12 +141,16 @@ class FsCheckpointStorage(CheckpointStorage):
         return os.path.join(self.directory, f"{prefix}-{checkpoint.checkpoint_id}")
 
     # -- chunking ------------------------------------------------------
-    def _write_chunk(self, arr: np.ndarray, ckpt_id: int) -> _ChunkRef:
+    def _write_chunk(self, arr: np.ndarray, ckpt_id: int) -> bytes:
+        """Write one page; returns its 16-byte content digest. The dtype
+        and leading dims participate in the hash (two byte-identical pages
+        of different dtype must not collide) but are NOT stored per page —
+        the enclosing _PagedState carries them once."""
         raw = np.ascontiguousarray(arr).tobytes()
         h = hashlib.blake2b(
-            raw + str((arr.dtype, arr.shape)).encode(),
-            digest_size=20).hexdigest()
-        path = os.path.join(self.chunk_dir, h)
+            raw + str((arr.dtype, arr.shape[:-1])).encode(),
+            digest_size=16).digest()
+        path = os.path.join(self.chunk_dir, h.hex())
         if not os.path.exists(path):
             from ..native import compress
             payload = compress(raw)
@@ -142,16 +159,27 @@ class FsCheckpointStorage(CheckpointStorage):
             os.replace(path + ".part", path)
             self.last_bytes_written += len(payload)
         self._refs.setdefault(h, set()).add(ckpt_id)
-        return _ChunkRef(h, str(arr.dtype), arr.shape)
+        return h
 
-    def _read_chunk(self, ref: _ChunkRef,
-                    chunk_dir: Optional[str] = None) -> np.ndarray:
-        with open(os.path.join(chunk_dir or self.chunk_dir, ref.hash),
+    def _read_chunk(self, ref, chunk_dir: Optional[str] = None,
+                    dtype: Optional[str] = None,
+                    lead_shape: Optional[tuple] = None) -> np.ndarray:
+        if isinstance(ref, _ChunkRef):  # legacy manifest
+            name, dt, shape = ref.hash, np.dtype(ref.dtype), ref.shape
+        else:
+            name, dt = ref.hex(), np.dtype(dtype)
+            shape = None
+        with open(os.path.join(chunk_dir or self.chunk_dir, name),
                   "rb") as f:
             from ..native import decompress
             raw = decompress(f.read())
-        return np.frombuffer(raw, dtype=np.dtype(ref.dtype)).reshape(
-            ref.shape).copy()
+        if shape is None:
+            lead = 1
+            for d in lead_shape:
+                lead *= d
+            n = len(raw) // dt.itemsize
+            shape = tuple(lead_shape) + (n // lead if lead else 0,)
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
 
     def _page_tpu_snapshot(self, snap: dict, ckpt_id: int) -> dict:
         """Reorder a device keyed snapshot by key group and replace its
@@ -172,10 +200,12 @@ class FsCheckpointStorage(CheckpointStorage):
         out = dict(snap)
         out["keys"] = _PagedState(
             [self._write_chunk(p, ckpt_id)
-             for p in np.split(keys, bounds)])
+             for p in np.split(keys, bounds)],
+            str(keys.dtype), ())
         out["key_groups"] = _PagedState(
             [self._write_chunk(p, ckpt_id)
-             for p in np.split(groups, bounds)])
+             for p in np.split(groups, bounds)],
+            str(groups.dtype), ())
         states = {}
         for name, sdata in snap["states"].items():
             vals = np.asarray(sdata["values"])
@@ -183,7 +213,8 @@ class FsCheckpointStorage(CheckpointStorage):
             pages = [self._write_chunk(np.ascontiguousarray(p), ckpt_id)
                      for p in np.split(vals, bounds, axis=-1)]
             sd = dict(sdata)
-            sd["values"] = _PagedState(pages)
+            sd["values"] = _PagedState(pages, str(vals.dtype),
+                                       vals.shape[:-1])
             states[name] = sd
         out["states"] = states
         return out
@@ -193,7 +224,12 @@ class FsCheckpointStorage(CheckpointStorage):
         if isinstance(obj, _ChunkRef):
             return self._read_chunk(obj, chunk_dir)
         if isinstance(obj, _PagedState):
-            parts = [self._read_chunk(r, chunk_dir) for r in obj.pages]
+            # pre-upgrade pickles carry only the 'pages' slot (of _ChunkRef
+            # entries, which ignore the dtype/lead_shape arguments)
+            dtype = getattr(obj, "dtype", None)
+            lead = getattr(obj, "lead_shape", None)
+            parts = [self._read_chunk(r, chunk_dir, dtype, lead)
+                     for r in obj.pages]
             parts = [p for p in parts if p.shape[-1]]
             if not parts:
                 return np.empty(0)
@@ -273,8 +309,9 @@ class FsCheckpointStorage(CheckpointStorage):
                 dead.append(h)
         for h in dead:
             self._refs.pop(h, None)
+            name = h.hex() if isinstance(h, bytes) else h
             try:
-                os.remove(os.path.join(self.chunk_dir, h))
+                os.remove(os.path.join(self.chunk_dir, name))
             except OSError:
                 pass
         if dead:

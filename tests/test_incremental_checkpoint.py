@@ -90,6 +90,34 @@ class TestIncrementalStorage:
                 if not f.startswith("_")]
         assert left == []
 
+    def test_legacy_manifest_still_loads(self, tmp_path):
+        """Pre-upgrade manifests pickled _PagedState with only a 'pages'
+        slot of _ChunkRef(hex-hash, dtype, shape) entries; load() must
+        still resolve them."""
+        import pickle
+        import hashlib
+        from flink_tpu.checkpoint.storage import _ChunkRef, _PagedState
+        from flink_tpu.native import compress
+
+        st = FsCheckpointStorage(str(tmp_path))
+        arr = np.arange(48, dtype=np.float64).reshape(3, 16)
+        raw = arr.tobytes()
+        h = hashlib.blake2b(raw, digest_size=20).hexdigest()
+        with open(os.path.join(st.chunk_dir, h), "wb") as f:
+            f.write(compress(raw))
+        legacy = _PagedState.__new__(_PagedState)
+        object.__setattr__(legacy, "pages",
+                           [_ChunkRef(h, "float64", (3, 16))])
+        cp = CompletedCheckpoint(
+            3, 0.0, {"task#0": {"keyed": {"vals": legacy}}})
+        d = os.path.join(str(tmp_path), "chk-3")
+        os.makedirs(d)
+        with open(os.path.join(d, "_metadata"), "wb") as f:
+            f.write(pickle.dumps(cp, protocol=pickle.HIGHEST_PROTOCOL))
+        loaded = st.load(d)
+        got = loaded.task_snapshots["task#0"]["keyed"]["vals"]
+        np.testing.assert_array_equal(got, arr)
+
     def test_savepoint_stays_self_contained(self, tmp_path):
         st = FsCheckpointStorage(str(tmp_path))
         b = _backend_with_keys(500)
