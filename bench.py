@@ -108,10 +108,35 @@ def _n_panes(n_events: int) -> int:
     return max(4, min(24, n_events // BATCH))
 
 
+def _collect_stages(env) -> dict:
+    """Per-stage wall-clock breakdown: source read/emit (SourceStreamTask
+    counters) + window ingest/fire/drain (operator counters)."""
+    from flink_tpu.runtime.operators.device_window import (
+        DeviceWindowAggOperator,
+    )
+    from flink_tpu.runtime.stream_task import SourceStreamTask
+
+    stages: dict[str, float] = {}
+    for task in env.last_job.tasks.values():
+        if isinstance(task, SourceStreamTask):
+            for k, v in task.stage_s.items():
+                stages[f"source_{k}"] = stages.get(f"source_{k}", 0.0) + v
+    for op in _find_ops(env, DeviceWindowAggOperator):
+        for k, v in op.stage_s.items():
+            stages[f"window_{k}"] = stages.get(f"window_{k}", 0.0) + v
+    return stages
+
+
 def _run_q5(n_keys: int, n_events: int, capacity: int,
-            pane_ms: int = 2000, topk: int = 1000):
+            pane_ms: int = 2000, topk: int = 1000, device: bool = True):
     """One env.execute() of the Q5 pipeline; returns (wall_seconds,
-    fire_latencies_ms, emitted_rows)."""
+    fire_latencies_ms, emitted_rows, stage_breakdown).
+
+    ``device=True`` is the TPU-native ingest: batches are born in HBM
+    (DataGenSource(device=True)) and the whole per-batch hot loop is one
+    compiled dispatch — zero host->device transfers. ``device=False``
+    measures the same pipeline with host-generated batches uploaded per
+    batch (what any host-resident source pays)."""
     import jax
     from flink_tpu.api import StreamExecutionEnvironment
     from flink_tpu.core import WatermarkStrategy
@@ -140,7 +165,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
         .with_timestamp_column("ts")
     sink = _CountSink()
     (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
-                 watermark_strategy=ws)
+                 watermark_strategy=ws, device=device)
         .key_by("auction")
         .window(SlidingEventTimeWindows.of(5 * pane_ms, pane_ms))
         .device_aggregate([AggSpec("count", out_name="bids")],
@@ -153,14 +178,19 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     wall = time.perf_counter() - t0
     ops = _find_ops(env, DeviceWindowAggOperator)
     lat = [ms for o in ops for ms in o.fire_latencies_ms]
-    return wall, lat, sink.rows
+    return wall, lat, sink.rows, _collect_stages(env)
 
 
-def bench_framework_q5(n_keys: int, n_events: int, capacity: int):
-    """Warmup run (compile) + timed run; returns (events/sec, p99 ms)."""
-    _run_q5(n_keys, min(n_events, 4 * BATCH), capacity)     # compile warmup
-    wall, lat, _rows = _run_q5(n_keys, n_events, capacity)
-    return n_events / wall, _p99(lat)
+def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
+                       device: bool = True):
+    """Warmup run (compile) + timed run; returns (events/sec, p99 ms,
+    stage breakdown)."""
+    _run_q5(n_keys, min(n_events, 4 * BATCH), capacity,
+            device=device)                                  # compile warmup
+    wall, lat, _rows, stages = _run_q5(n_keys, n_events, capacity,
+                                       device=device)
+    stages["wall"] = wall
+    return n_events / wall, _p99(lat), stages
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -199,7 +229,7 @@ def _run_q7(n_keys: int, n_events: int, capacity: int,
         .with_timestamp_column("ts")
     sink = _CountSink()
     (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
-                 watermark_strategy=ws)
+                 watermark_strategy=ws, device=True)
         .key_by("auction")
         .window(TumblingEventTimeWindows.of(pane_ms))
         .device_aggregate([AggSpec("max", "packed", out_name="best")],
@@ -384,30 +414,90 @@ def bench_host_q7() -> float:
     return HOST_EVENTS / dt
 
 
+def bench_tunnel() -> dict:
+    """Transfer/dispatch diagnostics for the chip (which may sit behind a
+    shared network tunnel): distinguishes framework regressions from link
+    regressions (VERDICT r2 weak #1 caveat)."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jax.device_put(np.ones(8, np.float32), dev)
+    f = jax.jit(lambda a: a + 1)
+    jax.block_until_ready(f(x))  # compile
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        rtts.append(time.perf_counter() - t0)
+    buf = np.random.default_rng(0).integers(
+        0, 1 << 60, 2_000_000).astype(np.int64)       # 16 MB
+    ups, downs = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d = jax.device_put(buf, dev)
+        jax.block_until_ready(d)
+        ups.append(16.0 / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        jax.device_get(d)
+        downs.append(16.0 / (time.perf_counter() - t0))
+    return {"dispatch_rtt_ms": _median(rtts) * 1e3,
+            "upload_MBps": _median(ups), "download_MBps": _median(downs)}
+
+
 def _line(metric, value, unit, vs):
     print(json.dumps({"metric": metric, "value": round(value, 2),
                       "unit": unit, "vs_baseline": round(vs, 2)}))
 
 
-def main() -> None:
+def _print_breakdown(stages: dict, prefix: str) -> None:
+    wall = stages.get("wall", 0.0)
+    for k in ("source_read", "source_emit", "window_ingest", "window_fire",
+              "window_drain"):
+        if k in stages:
+            _line(f"{prefix}_stage_{k}_ms", stages[k] * 1e3, "ms",
+                  stages[k] / wall if wall else 0.0)
+
+
+def _print_tunnel() -> None:
+    t = bench_tunnel()
+    _line("tunnel_dispatch_rtt", t["dispatch_rtt_ms"], "ms", 1.0)
+    _line("tunnel_upload_bandwidth", t["upload_MBps"], "MB/s", 1.0)
+    _line("tunnel_download_bandwidth", t["download_MBps"], "MB/s", 1.0)
+
+
+def main(breakdown: bool = False):
     host_eps = bench_host()
-    eps, p99 = bench_framework_q5(N_KEYS, 1 << 23, CAPACITY)
+    eps, p99, stages = bench_framework_q5(N_KEYS, 1 << 23, CAPACITY)
     _line("nexmark_q5_framework_events_per_sec_1M_keys", eps,
           "events/sec/chip", eps / host_eps)
-    return eps, p99, host_eps
+    if breakdown:
+        _print_breakdown(stages, "q5_1M")
+        _print_tunnel()
+    return eps, p99, stages, host_eps
 
 
 def suite() -> None:
     """Extended matrix (one JSON line per metric) — `python bench.py
     --suite`. The driver contract stays the single Q5 line in main()."""
-    eps, p99, host_eps = main()
+    eps, p99, stages, host_eps = main()
     _line("nexmark_q5_framework_p99_fire_latency_1M_keys", p99, "ms", 1.0)
+    _print_breakdown(stages, "q5_1M")
 
-    eps10, p99_10 = bench_framework_q5(10_000_000, 1 << 25, 1 << 24)
+    # host-resident ingest variant: what a source whose data is born on
+    # host pays in per-batch uploads (the device/host gap is the tunnel)
+    host_in_eps, _p, _s = bench_framework_q5(N_KEYS, 1 << 22, CAPACITY,
+                                             device=False)
+    _line("nexmark_q5_framework_host_ingest_events_per_sec_1M_keys",
+          host_in_eps, "events/sec/chip", host_in_eps / host_eps)
+
+    eps10, p99_10, stages10 = bench_framework_q5(10_000_000, 1 << 25,
+                                                 1 << 24)
     _line("nexmark_q5_framework_events_per_sec_10M_keys", eps10,
           "events/sec/chip", eps10 / host_eps)
     _line("nexmark_q5_framework_p99_fire_latency_10M_keys", p99_10,
           "ms", 1.0)
+    _print_breakdown(stages10, "q5_10M")
 
     q7_host = bench_host_q7()
     q7eps, q7p99 = bench_framework_q7(10_000_000, 1 << 25, 1 << 24)
@@ -423,6 +513,7 @@ def suite() -> None:
     kernel = bench_device()
     _line("q5_kernel_ceiling_events_per_sec_1M_keys", kernel,
           "events/sec/chip", kernel / host_eps)
+    _print_tunnel()
 
 
 if __name__ == "__main__":
@@ -430,4 +521,4 @@ if __name__ == "__main__":
     if "--suite" in sys.argv:
         suite()
     else:
-        main()
+        main(breakdown="--breakdown" in sys.argv)

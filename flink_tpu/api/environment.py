@@ -131,9 +131,13 @@ class StreamExecutionEnvironment:
                 timestamp_column: Optional[str] = None,
                 watermark_strategy: Optional[WatermarkStrategy] = None,
                 name: str = "DataGen",
-                parallelism: Optional[int] = None) -> DataStream:
+                parallelism: Optional[int] = None,
+                device: bool = False) -> DataStream:
+        """``device=True``: generate each batch on the accelerator and emit
+        device-resident batches (see DataGenSource) — the zero-transfer
+        ingest path for device pipelines."""
         src = DataGenSource(gen_fn, schema, count, rate_per_sec,
-                            timestamp_column)
+                            timestamp_column, device=device)
         return self.from_source(src, watermark_strategy, name, parallelism)
 
     # -- compile & run -----------------------------------------------------

@@ -154,18 +154,29 @@ class _CollectionReader(SourceReader):
 class DataGenSource(Source):
     """Rate-limitable generator source (reference flink-connector-datagen):
     gen_fn(index_array) -> dict of columns. Resume is exact: the only state
-    is the next index."""
+    is the next index.
+
+    ``device=True`` generates each batch ON the accelerator: ``gen_fn`` is
+    traced under jit over a device index vector and the reader emits
+    ``DeviceRecordBatch``es whose columns never touch the host — the
+    TPU-native ingest path (data born in HBM, zero host->device transfer).
+    Requires ``gen_fn`` to be jax-traceable (pure array arithmetic) and,
+    when ``timestamp_column`` is set, the timestamp to be NON-DECREASING in
+    the index (the event-time bounds of a batch are derived by evaluating
+    ``gen_fn`` on the batch's two endpoint indices on host — checked)."""
 
     def __init__(self, gen_fn: Callable[[np.ndarray], dict[str, np.ndarray]],
                  schema: Schema, count: Optional[int] = None,
                  rate_per_sec: Optional[float] = None,
-                 timestamp_column: Optional[str] = None):
+                 timestamp_column: Optional[str] = None,
+                 device: bool = False):
         self._gen = gen_fn
         self.schema = schema
         self._count = count
         self.bounded = count is not None
         self._rate = rate_per_sec
         self._ts_col = timestamp_column
+        self._device = bool(device)
 
     def create_splits(self, parallelism: int) -> list[SourceSplit]:
         return [SourceSplit(f"datagen-{i}", (i, parallelism))
@@ -173,6 +184,8 @@ class DataGenSource(Source):
 
     def create_reader(self, split: SourceSplit) -> SourceReader:
         subtask, parallelism = split.payload
+        if self._device:
+            return _DeviceDataGenReader(self, subtask, parallelism)
         return _DataGenReader(self, subtask, parallelism)
 
 
@@ -184,7 +197,8 @@ class _DataGenReader(SourceReader):
         self._next = 0
         self._started = time.time()
 
-    def read_batch(self, max_records: int) -> Optional[RecordBatch]:
+    def _plan_batch(self, max_records: int) -> Optional[int]:
+        """How many records the next batch may hold (None = exhausted)."""
         share = None
         if self._s._count is not None:
             total = self._s._count
@@ -192,14 +206,21 @@ class _DataGenReader(SourceReader):
                 1 if self._subtask < total % self._parallelism else 0)
             if self._next >= share:
                 return None
-        n = max_records if share is None else min(max_records, share - self._next)
+        n = max_records if share is None else min(max_records,
+                                                  share - self._next)
         if self._s._rate is not None:
             # admission control: stay under rate_per_sec for this subtask
             allowed = int((time.time() - self._started) * self._s._rate) \
                 - self._next
             n = min(n, max(allowed, 0))
-            if n == 0:
-                return RecordBatch.empty(self._s.schema)
+        return n
+
+    def read_batch(self, max_records: int) -> Optional[RecordBatch]:
+        n = self._plan_batch(max_records)
+        if n is None:
+            return None
+        if n == 0:
+            return RecordBatch.empty(self._s.schema)
         # global indices strided by subtask for determinism under parallelism
         idx = (self._next + np.arange(n)) * self._parallelism + self._subtask
         cols = self._s._gen(idx.astype(np.int64))
@@ -215,6 +236,121 @@ class _DataGenReader(SourceReader):
 
     def restore(self, state: Any) -> None:
         self._next = int(state)
+
+
+class _DeviceDataGenReader(_DataGenReader):
+    """Device-mode reader: one jitted program computes the batch's global
+    index vector AND the user columns entirely on device; the host touches
+    only two endpoint indices per batch (for event-time bounds, evaluated
+    through ``gen_fn`` on a 2-element numpy array — pure host arithmetic).
+
+    Monotonicity of the timestamp column in the index is the device-mode
+    contract (the endpoint bounds depend on it). It is VERIFIED on device —
+    each batch's program also reduces ``any(diff(ts) < 0)`` into a running
+    device flag, checked once when the source is exhausted/closed (the
+    deferred-health model of the tpu backend's ``defer_overflow``): no
+    per-batch sync, still fails loudly.
+    """
+
+    # distinct jitted shapes are bounded: full batches use their exact
+    # length, short batches (rate-limit slack, bounded-count tails) round
+    # DOWN to a power of two — ~log2(batch) shapes total, not one per n
+    _MAX_PROGS = 32
+
+    def __init__(self, source: DataGenSource, subtask: int, parallelism: int):
+        super().__init__(source, subtask, parallelism)
+        self._progs: dict[int, Any] = {}   # batch length -> jitted program
+        self._viol = None                  # device monotonicity violation
+        self._viol_checked = False
+        self._prev_last = np.int64(MIN_TIMESTAMP)  # prior batch's tail ts
+
+    def _program(self, n: int):
+        prog = self._progs.get(n)
+        if prog is None:
+            import jax
+            import jax.numpy as jnp
+            from ..ops.hash_table import ensure_x64
+
+            ensure_x64()
+            s = self._s
+            stride, off = self._parallelism, self._subtask
+            fields = s.schema.fields
+            ts_col = s._ts_col
+
+            @jax.jit
+            def prog(start, prev_last):
+                idx = (start + jnp.arange(n, dtype=jnp.int64)) * stride + off
+                cols = s._gen(idx)
+                out = {f.name: jnp.asarray(cols[f.name]).astype(f.dtype)
+                       for f in fields}
+                if ts_col is not None:
+                    ts = out[ts_col]
+                    # within the batch AND across the previous batch's tail
+                    viol = (jnp.any(ts[1:] < ts[:-1])
+                            | (ts[0].astype(jnp.int64) < prev_last))
+                    last = ts[-1].astype(jnp.int64)
+                else:
+                    viol, last = jnp.asarray(False), prev_last
+                return out, viol, last
+
+            if len(self._progs) >= self._MAX_PROGS:
+                self._progs.pop(next(iter(self._progs)))
+            self._progs[n] = prog
+        return prog
+
+    def _check_monotonic(self) -> None:
+        if self._viol is None or self._viol_checked:
+            return
+        import jax
+
+        self._viol_checked = True
+        if bool(jax.device_get(self._viol)):
+            raise ValueError(
+                "DataGenSource(device=True) contract violated: the "
+                f"timestamp column {self._s._ts_col!r} is not "
+                "non-decreasing in the index (detected on device); "
+                "window results for this run are unreliable — use "
+                "device=False or make gen_fn's timestamps monotonic")
+
+    def read_batch(self, max_records: int):
+        from ..core.device_records import DeviceRecordBatch
+
+        n = self._plan_batch(max_records)
+        if n is None:
+            self._check_monotonic()
+            return None
+        if n == 0:
+            return RecordBatch.empty(self._s.schema)
+        if n != max_records:
+            n = 1 << (n.bit_length() - 1)   # power-of-two shape bucket
+        first = self._next * self._parallelism + self._subtask
+        last = (self._next + n - 1) * self._parallelism + self._subtask
+        dcols, viol, tail_ts = self._program(n)(np.int64(self._next),
+                                                self._prev_last)
+        self._viol = viol if self._viol is None else self._viol | viol
+        self._viol_checked = False
+        self._prev_last = tail_ts
+        self._next += n
+        ts_col = self._s._ts_col
+        if ts_col is not None:
+            # event-time bounds from the endpoint indices, on host (two
+            # elements through the numpy path of gen_fn)
+            ends = np.asarray(
+                self._s._gen(np.array([first, last], np.int64))[ts_col])
+            ts_min, ts_max = int(ends[0]), int(ends[1])
+            if ts_min > ts_max:
+                raise ValueError(
+                    "DataGenSource(device=True) needs a timestamp column "
+                    f"non-decreasing in the index; got ts({first})={ts_min} "
+                    f"> ts({last})={ts_max}")
+            return DeviceRecordBatch(self._s.schema, dcols,
+                                     dcols[ts_col].astype(np.int64),
+                                     ts_min, ts_max, ts_column=ts_col)
+        return DeviceRecordBatch(self._s.schema, dcols, None,
+                                 MIN_TIMESTAMP, MIN_TIMESTAMP)
+
+    def close(self) -> None:
+        self._check_monotonic()
 
 
 class CollectSink(Sink):

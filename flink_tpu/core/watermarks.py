@@ -43,7 +43,12 @@ class _BoundedOutOfOrderness(WatermarkGenerator):
 
     def on_batch(self, batch: RecordBatch) -> None:
         if batch.n:
-            self._max_ts = max(self._max_ts, int(batch.timestamps.max()))
+            # device batches carry host event-time bounds; reading their
+            # .timestamps would force a device->host transfer
+            mx = getattr(batch, "ts_max", None)
+            if mx is None:
+                mx = int(batch.timestamps.max())
+            self._max_ts = max(self._max_ts, mx)
 
     def current_watermark(self) -> int:
         return self._max_ts - self._delay - 1
@@ -106,6 +111,23 @@ class WatermarkStrategy:
 
     def assign_timestamps(self, batch: RecordBatch) -> RecordBatch:
         if self.timestamp_column is not None:
+            if getattr(batch, "is_device", False):
+                # usually the source already bound THIS column with
+                # analytic bounds; a late bind (no binding yet, or the
+                # strategy names a different column than the source did)
+                # must also repair the ts_min/ts_max metadata the pane
+                # bookkeeping and watermark generator trust — one blocking
+                # reduce, correctness over speed on this rare path
+                if (batch.dtimestamps is None
+                        or batch.ts_column != self.timestamp_column):
+                    import jax
+
+                    col = batch.device_column(self.timestamp_column)
+                    batch.dtimestamps = col
+                    batch.ts_column = self.timestamp_column
+                    lo, hi = jax.device_get((col.min(), col.max()))
+                    batch.ts_min, batch.ts_max = int(lo), int(hi)
+                return batch
             return batch.with_timestamps(
                 batch.column(self.timestamp_column).astype(np.int64))
         if self.timestamp_assigner is not None:

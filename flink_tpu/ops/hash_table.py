@@ -40,10 +40,20 @@ def ensure_x64() -> None:
         _X64_READY = True
 
 __all__ = ["EMPTY_KEY", "make_table", "lookup", "lookup_or_insert",
-           "hash_keys_device", "ensure_x64", "MAX_PROBES"]
+           "hash_keys_device", "sanitize_keys_device", "ensure_x64",
+           "MAX_PROBES"]
 
 EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
 MAX_PROBES = 128
+
+
+def sanitize_keys_device(keys: jax.Array) -> jax.Array:
+    """Remap the EMPTY sentinel (int64 max) to int64 max - 1 — THE sentinel
+    rule, shared by every device ingest path (host twin:
+    state/tpu_backend._sanitize_keys)."""
+    keys = keys.astype(jnp.int64)
+    return jnp.where(keys == jnp.int64(EMPTY_KEY), jnp.int64(EMPTY_KEY) - 1,
+                     keys)
 
 
 def make_table(capacity: int) -> jax.Array:

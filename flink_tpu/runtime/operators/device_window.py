@@ -34,9 +34,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core.device_records import DeviceRecordBatch
 from ...core.elements import Watermark
 from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
-from ...ops.hash_table import EMPTY_KEY
+from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
+    sanitize_keys_device
 from ...state.tpu_backend import TpuKeyedStateBackend
 from ...window.assigners import WindowAssigner
 from .base import OneInputOperator, OperatorContext, Output
@@ -69,6 +71,50 @@ def _masked_topk(values: jax.Array, valid: jax.Array, k: int):
     kk = min(k, values.shape[0])
     vals, idx = jax.lax.top_k(masked, kk)
     return vals, idx, jnp.take(valid, idx)
+
+
+@functools.lru_cache(maxsize=128)
+def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int):
+    """ONE compiled program per batch for the device-resident ingest path:
+    pane assignment + late masking + hash-table lookup-or-insert + every
+    scatter-fold, over columns that are ALREADY in HBM (DeviceRecordBatch).
+    This is the whole per-batch hot loop in a single dispatch — the analog
+    of the reference's record loop StreamTask.processInput:588 →
+    WindowOperator.processElement:278, executed once per micro-batch with
+    zero host<->device transfers. State buffers are donated so XLA updates
+    them in place instead of copying [ring, capacity] arrays every batch.
+
+    ``fold_sig`` is a tuple of (fold_kind, state_name, field). The count
+    plane ("__count__") folds implicitly.
+    """
+    from ...ops.segment_ops import scatter_fold
+
+    donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+
+    @partial(jax.jit, donate_argnums=donate)
+    def step_fn(table, arrays, dropped, late, keys, ts, cols, first_open):
+        panes = (ts.astype(jnp.int64) - offset) // pane
+        fresh = panes >= first_open
+        late = late + jnp.sum(~fresh).astype(jnp.int64)
+        keys = sanitize_keys_device(keys)
+        table, slots, ok = lookup_or_insert(table, keys, fresh)
+        dropped = dropped + jnp.sum(~ok & fresh).astype(jnp.int64)
+        ring_idx = (panes % ring).astype(jnp.int32)
+        count = arrays["__count__"]
+        cap = count.shape[1]
+        flat = ring_idx * cap + jnp.maximum(slots, 0)
+        out = dict(arrays)
+        out["__count__"] = scatter_fold(
+            "count", count.reshape(-1), flat,
+            jnp.ones(keys.shape[0], jnp.int64), ok).reshape(count.shape)
+        for kind, name, field in fold_sig:
+            arr = arrays[name]
+            vals = cols[field].astype(arr.dtype)
+            out[name] = scatter_fold(kind, arr.reshape(-1), flat, vals,
+                                     ok).reshape(arr.shape)
+        return table, out, dropped, late
+
+    return step_fn
 
 
 @functools.lru_cache(maxsize=128)
@@ -166,6 +212,12 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         self._pending: deque = deque()
         self._fire_fn = None
         self._out_schema: Optional[Schema] = None
+        self._late_dev = None  # device late-drop counter (device ingest)
+        # wall-clock per hot-path stage (bench breakdown): ingest = pack +
+        # upload + fold dispatch, fire = fire dispatch, drain = result
+        # materialization + emit
+        self.stage_s: dict[str, float] = {"ingest": 0.0, "fire": 0.0,
+                                          "drain": 0.0}
 
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
@@ -226,8 +278,67 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
                     f"{self._key_column!r} is {key_dtype} — use the hashmap "
                     "state backend for float/string keys")
             self._register_aggs(batch.schema)
-        keys = batch.column(self._key_column).astype(np.int64)
-        self._ingest(batch, keys)
+        t0 = time.perf_counter()
+        if (isinstance(batch, DeviceRecordBatch) and self._defer
+                and batch.dtimestamps is not None):
+            self._ingest_device(batch)
+        else:
+            keys = batch.column(self._key_column).astype(np.int64)
+            self._ingest(batch, keys)
+        self.stage_s["ingest"] += time.perf_counter() - t0
+
+    # -- device-resident ingest (zero-transfer hot path) --------------------
+    def _fold_sig(self) -> tuple:
+        sig = []
+        for a in self._aggs:
+            if a.kind == "count":
+                continue
+            name = f"{a.out_name}.sum" if a.kind == "avg" else a.out_name
+            sig.append(("sum" if a.kind == "avg" else a.kind, name, a.field))
+        return tuple(sig)
+
+    def _ingest_device(self, batch: DeviceRecordBatch) -> None:
+        """Whole-batch ingest of device-born columns: host does only pane
+        bookkeeping on the batch's event-time BOUNDS; the data plane is one
+        compiled dispatch (see _step_program). Late records are masked and
+        counted on device; a batch wholly behind the fired boundary is
+        dropped without any device work at all."""
+        pane_lo = (batch.ts_min - self._offset) // self._pane
+        pane_hi = (batch.ts_max - self._offset) // self._pane
+        first_open = (self._fired_boundary - self._window_panes
+                      if self._fired_boundary is not None else None)
+        if first_open is not None and pane_hi < first_open:
+            self._late_dropped += batch.n
+            return
+        eff_lo = pane_lo if first_open is None else max(pane_lo, first_open)
+        self._max_seen_pane = (pane_hi if self._max_seen_pane is None
+                               else max(self._max_seen_pane, pane_hi))
+        self._min_seen_pane = (eff_lo if self._min_seen_pane is None
+                               else min(self._min_seen_pane, eff_lo))
+        low = (first_open if self._fired_boundary is not None
+               else self._min_seen_pane)
+        if pane_hi - low >= self._ring:
+            raise RuntimeError(
+                f"pane ring overflow: open span [{low},{pane_hi}] exceeds "
+                f"ring {self._ring}; increase ring_size or reduce "
+                "watermark lag")
+        if self._late_dev is None:
+            self._late_dev = jnp.zeros((), jnp.int64)
+        sig = self._fold_sig()
+        step = _step_program(sig, self._ring, self._pane, self._offset)
+        arrays = {n: self._backend.get_array(n)
+                  for n in self._fire_array_names()}
+        cols = {f: batch.device_column(f) for _k, _n, f in sig}
+        fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
+        table, new_arrays, dropped, late = step(
+            self._backend.table, arrays, self._backend.dropped_device,
+            self._late_dev, batch.device_column(self._key_column),
+            batch.dtimestamps, cols, fo)
+        self._backend.table = table
+        for n, a in new_arrays.items():
+            self._backend.set_array(n, a)
+        self._backend._dropped = dropped
+        self._late_dev = late
 
     def _fold(self, batch: RecordBatch, keys: np.ndarray,
               panes: np.ndarray) -> None:
@@ -293,6 +404,7 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
     # fires so it never overtakes them downstream.
 
     def _fire(self, p_end: int) -> None:
+        t_fire = time.perf_counter()
         W = self._window_panes
         # never read panes below min_seen: they hold no data and their ring
         # rows may be occupied by live FUTURE panes (row aliasing)
@@ -327,6 +439,7 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         # (skip panes below min_seen — their ring rows belong to live panes)
         if p_end - W >= self._min_seen_pane:
             self._backend.reset_ring_row((p_end - W) % self._ring)
+        self.stage_s["fire"] += time.perf_counter() - t_fire
 
     def _fire_array_names(self) -> list[str]:
         names = ["__count__"]
@@ -359,6 +472,7 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         return keys, res
 
     def _materialize(self, item) -> None:
+        t_drain = time.perf_counter()
         p_end, outs, host_part, t0 = item
         host = jax.device_get(outs)       # ONE transfer for everything
         if self._topk is not None:
@@ -386,11 +500,10 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
                     kind="stable")[:self._topk]
                 keys = keys[order]
                 results = {n: v[order] for n, v in results.items()}
-        if len(keys) == 0:
-            self._note_latency(t0)
-            return
-        self._emit_rows(p_end, keys, results)
+        if len(keys):
+            self._emit_rows(p_end, keys, results)
         self._note_latency(t0)
+        self.stage_s["drain"] += time.perf_counter() - t_drain
 
     def _note_latency(self, t0: float) -> None:
         from .slice_control import _MAX_FIRE_SAMPLES
@@ -439,6 +552,13 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
 
     def finish(self) -> None:
         self._drain(block=True)
+
+    @property
+    def late_dropped(self) -> int:
+        late = self._late_dropped
+        if self._late_dev is not None:
+            late += int(jax.device_get(self._late_dev))
+        return late
 
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:

@@ -189,6 +189,9 @@ class SourceStreamTask(StreamTask):
         self.ws = watermark_strategy
         self.chain = chain  # chained operators after the source, may be None
         self._restored_reader_state: Any = None
+        # wall-clock spent per stage of the source loop (observability /
+        # bench breakdown): read = generator/IO, emit = chain + backpressure
+        self.stage_s: dict[str, float] = {"read": 0.0, "emit": 0.0}
 
     def restore_state(self, snapshot: Optional[dict]) -> None:
         if not snapshot:
@@ -228,7 +231,9 @@ class SourceStreamTask(StreamTask):
 
         while not self._cancelled.is_set():
             self._drain_mailbox()
+            t0 = time.perf_counter()
             batch = self.reader.read_batch(batch_size)
+            self.stage_s["read"] += time.perf_counter() - t0
             if batch is None:  # exhausted (bounded)
                 break
             if batch.n:
@@ -240,10 +245,12 @@ class SourceStreamTask(StreamTask):
                 if idle:
                     idle = False
                     self.broadcast_all(WatermarkStatus(True))
+                t0 = time.perf_counter()
                 if self.chain is not None:
                     self.chain.process_batch(batch)
                 else:
                     out.emit(batch)
+                self.stage_s["emit"] += time.perf_counter() - t0
             else:
                 time.sleep(0.001)  # unbounded source, nothing available
                 if (idle_timeout is not None and not idle

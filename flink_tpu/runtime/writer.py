@@ -114,14 +114,16 @@ class KeyGroupPartitioner(StreamPartitioner):
         self.max_parallelism = max_parallelism
 
     def route(self, batch, num_channels, subtask_index):
+        if num_channels == 1:
+            # every key group maps to subtask 0: forward the handle without
+            # touching the columns (device batches stay on device)
+            return [(0, batch)]
         keys = self._key_extractor(batch)
         hashes = hash_batch(keys)
         groups = key_groups_for_hash_batch(hashes, self.max_parallelism)
         # subtask = kg * parallelism // max_parallelism, vectorized
         targets = (groups.astype(np.int64) * num_channels
                    // self.max_parallelism).astype(np.int32)
-        if num_channels == 1:
-            return [(0, batch)]
         parts = batch.split_by(targets, num_channels)
         return [(i, p) for i, p in enumerate(parts) if p.n]
 

@@ -33,7 +33,7 @@ import numpy as np
 from ..core.keygroups import KeyGroupRange, hash_batch, \
     key_groups_for_hash_batch
 from ..ops.hash_table import (
-    EMPTY_KEY, lookup, lookup_or_insert, make_table,
+    EMPTY_KEY, lookup, lookup_or_insert, make_table, sanitize_keys_device,
 )
 from ..ops.segment_ops import AGG_INITS, make_accumulator, scatter_fold
 from .backend import KeyedStateBackend, State, ValueState, register_backend
@@ -333,8 +333,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         if not self._defer:
             raise RuntimeError("device-resident slot resolution requires "
                                "defer_overflow mode")
-        dkeys = jnp.where(dkeys == jnp.int64(EMPTY_KEY),
-                          jnp.int64(EMPTY_KEY) - 1, dkeys)
+        dkeys = sanitize_keys_device(dkeys)
         self.table, slots, ok = lookup_or_insert(self.table, dkeys)
         self._dropped = self._dropped + jnp.sum(~ok).astype(jnp.int64)
         return slots
