@@ -352,6 +352,30 @@ class _DeviceDataGenReader(_DataGenReader):
     def close(self) -> None:
         self._check_monotonic()
 
+    # -- checkpointing: the deferred violation flag and the cross-batch
+    # tail timestamp are part of the reader's exact-resume state ---------
+    def snapshot(self) -> Any:
+        import jax
+
+        viol = (bool(jax.device_get(self._viol))
+                if self._viol is not None else False)
+        return {"next": self._next, "prev_last": int(self._prev_last),
+                "viol": viol}
+
+    def restore(self, state: Any) -> None:
+        if isinstance(state, dict):
+            self._next = int(state["next"])
+            self._prev_last = np.int64(state["prev_last"])
+            if state.get("viol"):
+                # the violation predates this checkpoint; resuming would
+                # silently launder it
+                raise ValueError(
+                    "DataGenSource(device=True) checkpoint records a "
+                    "timestamp-monotonicity contract violation; the job's "
+                    "window results are unreliable — fix gen_fn")
+        else:  # pre-upgrade snapshot: bare index
+            self._next = int(state)
+
 
 class CollectSink(Sink):
     """Collects rows into a shared list — the test/ITCase sink

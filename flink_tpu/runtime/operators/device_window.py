@@ -74,7 +74,8 @@ def _masked_topk(values: jax.Array, valid: jax.Array, k: int):
 
 
 @functools.lru_cache(maxsize=128)
-def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int):
+def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
+                  dirty_block: int):
     """ONE compiled program per batch for the device-resident ingest path:
     pane assignment + late masking + hash-table lookup-or-insert + every
     scatter-fold, over columns that are ALREADY in HBM (DeviceRecordBatch).
@@ -89,20 +90,24 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int):
     """
     from ...ops.segment_ops import scatter_fold
 
-    donate = (0, 1, 2, 3) if jax.default_backend() != "cpu" else ()
+    donate = (0, 1, 2, 3, 4) if jax.default_backend() != "cpu" else ()
 
     @partial(jax.jit, donate_argnums=donate)
-    def step_fn(table, arrays, dropped, late, keys, ts, cols, first_open):
+    def step_fn(table, arrays, dropped, late, dirty, keys, ts, cols,
+                first_open):
         panes = (ts.astype(jnp.int64) - offset) // pane
         fresh = panes >= first_open
         late = late + jnp.sum(~fresh).astype(jnp.int64)
         keys = sanitize_keys_device(keys)
         table, slots, ok = lookup_or_insert(table, keys, fresh)
         dropped = dropped + jnp.sum(~ok & fresh).astype(jnp.int64)
-        ring_idx = (panes % ring).astype(jnp.int32)
         count = arrays["__count__"]
         cap = count.shape[1]
-        flat = ring_idx * cap + jnp.maximum(slots, 0)
+        # int64 flat index once ring*capacity could overflow int32 (tables
+        # auto-grow by doubling; shapes are static so this is trace-time)
+        idt = jnp.int64 if ring * cap > (1 << 31) - 1 else jnp.int32
+        ring_idx = (panes % ring).astype(idt)
+        flat = ring_idx * cap + jnp.maximum(slots, 0).astype(idt)
         out = dict(arrays)
         out["__count__"] = scatter_fold(
             "count", count.reshape(-1), flat,
@@ -112,7 +117,9 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int):
             vals = cols[field].astype(arr.dtype)
             out[name] = scatter_fold(kind, arr.reshape(-1), flat, vals,
                                      ok).reshape(arr.shape)
-        return table, out, dropped, late
+        # incremental-snapshot capture: mark touched dirty blocks
+        dirty = dirty.at[jnp.maximum(slots, 0) // dirty_block].set(True)
+        return table, out, dropped, late, dirty
 
     return step_fn
 
@@ -325,19 +332,22 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         if self._late_dev is None:
             self._late_dev = jnp.zeros((), jnp.int64)
         sig = self._fold_sig()
-        step = _step_program(sig, self._ring, self._pane, self._offset)
+        step = _step_program(sig, self._ring, self._pane, self._offset,
+                             self._backend.dirty_block_size)
         arrays = {n: self._backend.get_array(n)
                   for n in self._fire_array_names()}
         cols = {f: batch.device_column(f) for _k, _n, f in sig}
         fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
-        table, new_arrays, dropped, late = step(
+        table, new_arrays, dropped, late, dirty = step(
             self._backend.table, arrays, self._backend.dropped_device,
-            self._late_dev, batch.device_column(self._key_column),
+            self._late_dev, self._backend.dirty_mask,
+            batch.device_column(self._key_column),
             batch.dtimestamps, cols, fo)
         self._backend.table = table
         for n, a in new_arrays.items():
             self._backend.set_array(n, a)
         self._backend._dropped = dropped
+        self._backend.set_dirty_mask(dirty)
         self._late_dev = late
 
     def _fold(self, batch: RecordBatch, keys: np.ndarray,
