@@ -564,13 +564,17 @@ class WindowedStream:
                          emit_topk: Optional[int] = None,
                          defer_overflow: bool = False,
                          async_fire: bool = False,
+                         hbm_budget_slots: int = 0,
+                         spill_staging_slots: int = 1 << 16,
                          name: str = "DeviceWindowAgg") -> DataStream:
         """Explicit device window aggregation with multiple AggSpecs
         (key, [window_start, window_end], *agg columns). ``emit_topk=k``
         emits only the top-k keys by the first aggregate per window (the
         Nexmark Q5 hot-items fire shape, ranked on device).
         ``defer_overflow``/``async_fire`` remove all host syncs from the
-        hot path (see DeviceWindowAggOperator)."""
+        hot path (see DeviceWindowAggOperator). ``hbm_budget_slots`` caps
+        device state and pages cold key groups to host RAM — composable
+        with the deferred fast path (device-side split + staging)."""
         from ..runtime.operators.device_window import DeviceWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("device aggregation needs a column key")
@@ -582,7 +586,8 @@ class WindowedStream:
                 assigner, key_col, aggs, capacity=capacity,
                 ring_size=ring_size, emit_window_bounds=emit_window_bounds,
                 emit_topk=emit_topk, defer_overflow=defer_overflow,
-                async_fire=async_fire, name=name)
+                async_fire=async_fire, hbm_budget_slots=hbm_budget_slots,
+                spill_staging_slots=spill_staging_slots, name=name)
 
         par = 1 if self._all else None
         return self.keyed._one_input(name, factory, parallelism=par,

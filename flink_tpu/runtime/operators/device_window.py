@@ -75,7 +75,7 @@ def _masked_topk(values: jax.Array, valid: jax.Array, k: int):
 
 @functools.lru_cache(maxsize=128)
 def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
-                  dirty_block: int):
+                  dirty_block: int, spill_maxp: int = 0):
     """ONE compiled program per batch for the device-resident ingest path:
     pane assignment + late masking + hash-table lookup-or-insert + every
     scatter-fold, over columns that are ALREADY in HBM (DeviceRecordBatch).
@@ -87,20 +87,53 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
 
     ``fold_sig`` is a tuple of (fold_kind, state_name, field). The count
     plane ("__count__") folds implicitly.
+
+    ``spill_maxp`` > 0 enables the deferred-spill split (HBM budget +
+    defer_overflow): records of spilled key groups — and failed inserts —
+    are excluded from the device fold and compacted into the ``stage``
+    buffers for the host tier, still with zero host syncs; the per-group
+    LRU clock updates on device. Stage overflow (more rows than the
+    staging capacity between watermarks) counts into ``dropped`` and
+    fails loudly at the next health check.
     """
     from ...ops.segment_ops import scatter_fold
 
-    donate = (0, 1, 2, 3, 4) if jax.default_backend() != "cpu" else ()
+    spill = spill_maxp > 0
+    donate = ((0, 1, 2, 3, 4, 5, 6) if spill else (0, 1, 2, 3, 4)) \
+        if jax.default_backend() != "cpu" else ()
 
     @partial(jax.jit, donate_argnums=donate)
-    def step_fn(table, arrays, dropped, late, dirty, keys, ts, cols,
-                first_open):
+    def step_fn(table, arrays, dropped, late, dirty, stage, touch, keys, ts,
+                cols, spilled, batch_no, first_open):
         panes = (ts.astype(jnp.int64) - offset) // pane
         fresh = panes >= first_open
         late = late + jnp.sum(~fresh).astype(jnp.int64)
         keys = sanitize_keys_device(keys)
-        table, slots, ok = lookup_or_insert(table, keys, fresh)
-        dropped = dropped + jnp.sum(~ok & fresh).astype(jnp.int64)
+        if spill:
+            from ...parallel.mesh import key_groups_device
+
+            groups = key_groups_device(keys, spill_maxp)
+            touch = touch.at[groups].max(batch_no)
+            sp = spilled[groups]
+            table, slots, ok = lookup_or_insert(table, keys, fresh & ~sp)
+            to_host = fresh & (sp | ~ok)
+            S = stage["keys"].shape[0]
+            base = stage["count"]
+            pos = base + jnp.cumsum(to_host) - 1
+            can = to_host & (pos < S)
+            dropped = dropped + jnp.sum(to_host & ~can).astype(jnp.int64)
+            widx = jnp.where(can, pos, S).astype(jnp.int64)
+            stage = dict(stage)
+            stage["keys"] = stage["keys"].at[widx].set(keys, mode="drop")
+            stage["ring"] = stage["ring"].at[widx].set(
+                (panes % ring).astype(jnp.int32), mode="drop")
+            for _kind, name, field in fold_sig:
+                stage[name] = stage[name].at[widx].set(
+                    cols[field].astype(stage[name].dtype), mode="drop")
+            stage["count"] = base + jnp.sum(to_host).astype(jnp.int64)
+        else:
+            table, slots, ok = lookup_or_insert(table, keys, fresh)
+            dropped = dropped + jnp.sum(~ok & fresh).astype(jnp.int64)
         count = arrays["__count__"]
         cap = count.shape[1]
         # int64 flat index once ring*capacity could overflow int32 (tables
@@ -119,7 +152,7 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
                                      ok).reshape(arr.shape)
         # incremental-snapshot capture: mark touched dirty blocks
         dirty = dirty.at[jnp.maximum(slots, 0) // dirty_block].set(True)
-        return table, out, dropped, late, dirty
+        return table, out, dropped, late, dirty, stage, touch
 
     return step_fn
 
@@ -177,6 +210,7 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
                  defer_overflow: bool = False,
                  async_fire: bool = False,
                  hbm_budget_slots: int = 0,
+                 spill_staging_slots: int = 1 << 16,
                  name: str = "DeviceWindowAgg"):
         """``emit_topk``: emit only the k keys with the largest value of the
         FIRST aggregate per window (one device lax.top_k instead of a full
@@ -211,6 +245,8 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         self._defer = bool(defer_overflow)
         self._async = bool(async_fire)
         self._hbm_budget = int(hbm_budget_slots)
+        self._stage_slots = int(spill_staging_slots)
+        self._stage = None  # deferred-spill staging buffers (device)
 
         self._backend: Optional[TpuKeyedStateBackend] = None
         self._init_control_plane()
@@ -289,10 +325,32 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         if (isinstance(batch, DeviceRecordBatch) and self._defer
                 and batch.dtimestamps is not None):
             self._ingest_device(batch)
+        elif self._spill_deferred:
+            # deferred spill runs the fused device split for host batches
+            # too: upload the needed columns and go through the one-dispatch
+            # path (the staging compaction needs the device key groups)
+            self._ingest_device(self._to_device_batch(batch))
         else:
             keys = batch.column(self._key_column).astype(np.int64)
             self._ingest(batch, keys)
         self.stage_s["ingest"] += time.perf_counter() - t0
+
+    @property
+    def _spill_deferred(self) -> bool:
+        return (self._defer and self._backend is not None
+                and self._backend.hbm_budget > 0)
+
+    def _to_device_batch(self, batch: RecordBatch) -> DeviceRecordBatch:
+        cols = {self._key_column: jnp.asarray(
+            batch.column(self._key_column).astype(np.int64))}
+        for a in self._aggs:
+            if a.field is not None and a.field not in cols:
+                cols[a.field] = jnp.asarray(batch.column(a.field))
+        schema = Schema([(f.name, f.dtype) for f in batch.schema.fields
+                         if f.name in cols])
+        ts = batch.timestamps
+        return DeviceRecordBatch(schema, cols, jnp.asarray(ts),
+                                 int(ts.min()), int(ts.max()))
 
     # -- device-resident ingest (zero-transfer hot path) --------------------
     def _fold_sig(self) -> tuple:
@@ -331,24 +389,71 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
                 "watermark lag")
         if self._late_dev is None:
             self._late_dev = jnp.zeros((), jnp.int64)
+        spill = self._spill_deferred
+        if spill and self._stage is None:
+            self._alloc_stage()
         sig = self._fold_sig()
         step = _step_program(sig, self._ring, self._pane, self._offset,
-                             self._backend.dirty_block_size)
+                             self._backend.dirty_block_size,
+                             self._backend.max_parallelism if spill else 0)
         arrays = {n: self._backend.get_array(n)
                   for n in self._fire_array_names()}
         cols = {f: batch.device_column(f) for _k, _n, f in sig}
         fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
-        table, new_arrays, dropped, late, dirty = step(
+        table, new_arrays, dropped, late, dirty, stage, touch = step(
             self._backend.table, arrays, self._backend.dropped_device,
             self._late_dev, self._backend.dirty_mask,
+            self._stage if spill else None,
+            self._backend.touch_device if spill else None,
             batch.device_column(self._key_column),
-            batch.dtimestamps, cols, fo)
+            batch.dtimestamps, cols,
+            self._backend.spilled_mask_device if spill else None,
+            np.int64(self._backend.note_batch()) if spill else np.int64(0),
+            fo)
         self._backend.table = table
         for n, a in new_arrays.items():
             self._backend.set_array(n, a)
         self._backend._dropped = dropped
         self._backend.set_dirty_mask(dirty)
         self._late_dev = late
+        if spill:
+            self._stage = stage
+            self._backend.set_touch_device(touch)
+
+    def _alloc_stage(self) -> None:
+        S = self._stage_slots
+        st = {"keys": jnp.zeros(S, jnp.int64),
+              "ring": jnp.zeros(S, jnp.int32),
+              "count": jnp.zeros((), jnp.int64)}
+        for _k, name, _f in self._fold_sig():
+            st[name] = jnp.zeros(S, self._backend.get_array(name).dtype)
+        self._stage = st
+
+    def _pre_fire_flush(self) -> None:
+        """Deferred spill: staged host-tier rows must land before any fire
+        merges host parts (exactly-once per window). One tiny scalar sync
+        per watermark, a buffer transfer only when something was staged."""
+        if self._stage is None:
+            return
+        cnt = int(jax.device_get(self._stage["count"]))
+        if cnt == 0:
+            return
+        take = min(cnt, self._stage_slots)
+        # transfer only the written prefix, rounded up to a power of two so
+        # the slice program compiles O(log S) times, not once per count
+        span = min(1 << (take - 1).bit_length() if take > 1 else 1,
+                   self._stage_slots)
+        host = jax.device_get({k: v[:span] for k, v in self._stage.items()
+                               if k != "count"})
+        keys = np.asarray(host["keys"])[:take]
+        ring = np.asarray(host["ring"])[:take]
+        vals = {"__count__": np.ones(take, np.int64)}
+        for _k, name, _f in self._fold_sig():
+            vals[name] = np.asarray(host[name])[:take]
+        self._backend.drain_staged(keys, ring, vals)
+        # buffers are reusable (only [0:count) is ever read): reset the
+        # write position alone
+        self._stage["count"] = jnp.zeros((), jnp.int64)
 
     def _fold(self, batch: RecordBatch, keys: np.ndarray,
               panes: np.ndarray) -> None:
@@ -573,5 +678,6 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:
         self._drain(block=True)
+        self._pre_fire_flush()  # staged spill rows belong in the snapshot
         return {"keyed": {"backend": self._backend.snapshot(checkpoint_id),
                           "meta": self._control_meta()}}
