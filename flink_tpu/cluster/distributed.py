@@ -34,12 +34,13 @@ import socket
 import struct
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ..checkpoint.storage import CompletedCheckpoint, FsCheckpointStorage, \
     MemoryCheckpointStorage
 from ..core.config import CheckpointingOptions, Configuration, RuntimeOptions
+from .failover import restart_strategy_from_config
 from ..graph.stream_graph import JobGraph
 from ..runtime.channels import InputGate, LocalChannel
 from ..runtime.operators.base import OperatorChain, OperatorContext
@@ -88,6 +89,15 @@ class _WorkerState:
     sock: socket.socket
     last_heartbeat: float
     finished: bool = False
+    # the worker's vertex-id -> uid map: SPMD graphs are structurally
+    # identical but generated vertex ids may differ (process-global
+    # counter when several graphs are built in one process), so snapshot
+    # task ids are canonicalized through uids
+    uids: dict = None
+    # serializes sends to this worker's socket: broadcasts originate from
+    # several coordinator threads and a large inline-checkpoint restart
+    # payload must not interleave with control frames
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
 class _Coordinator:
@@ -116,6 +126,17 @@ class _Coordinator:
         self.completed: list[CompletedCheckpoint] = []
         self._vertex_parallelism: dict[str, int] = {}
         self._vertex_uids: dict[str, str] = {}
+        # distributed failover (reference RestartPipelinedRegionFailover-
+        # Strategy + backoff): epoch counts execution attempts; on worker
+        # death the job redeploys over the survivors from the latest
+        # completed checkpoint instead of cancelling
+        self.epoch = 0
+        self.restarts = 0
+        self._strategy = restart_strategy_from_config(config)
+        self._expected: set[int] = set(range(n_hosts))
+        self._all_done_sent = False
+        self._restart_inflight = False
+        self._hb_timeout = 5.0  # refined when monitor() starts
         threading.Thread(target=self._accept_loop, name="coord-accept",
                          daemon=True).start()
 
@@ -147,7 +168,9 @@ class _Coordinator:
                     host_id = msg["host_id"]
                     with self._lock:
                         self._workers[host_id] = _WorkerState(
-                            host_id, conn, time.time())
+                            host_id, conn, time.time(),
+                            uids=msg.get("uids") or {})
+                        self._all_done_sent = False
                 elif kind == "heartbeat":
                     with self._lock:
                         w = self._workers.get(msg["host_id"])
@@ -165,8 +188,16 @@ class _Coordinator:
                         if w:
                             w.finished = True
                 elif kind == "failed":
-                    self.failed = msg.get("error", "unknown")
-                    self.broadcast({"type": "cancel"})
+                    with self._lock:
+                        stale = (msg.get("epoch", 0) < self.epoch
+                                 or self.failed is not None)
+                    if stale:
+                        pass  # a previous attempt's report, already handled
+                    elif not self._maybe_restart(
+                            [], f"task failure on host {msg['host_id']}: "
+                                f"{msg.get('error', 'unknown')}"):
+                        self.failed = msg.get("error", "unknown")
+                        self.broadcast({"type": "cancel"})
         except OSError:
             pass
 
@@ -175,7 +206,8 @@ class _Coordinator:
             workers = list(self._workers.values())
         for w in workers:
             try:
-                _send_msg(w.sock, msg)
+                with w.send_lock:
+                    _send_msg(w.sock, msg)
             except OSError:
                 pass
 
@@ -185,7 +217,7 @@ class _Coordinator:
         registered yet (triggering early would complete with a subset of
         the tasks — not a consistent snapshot)."""
         with self._lock:
-            if len(self._workers) < self.n_hosts:
+            if not set(self._workers) >= self._expected:
                 return -1
             cid = self._next_cid
             self._next_cid += 1
@@ -195,13 +227,33 @@ class _Coordinator:
                         "savepoint": is_savepoint})
         return cid
 
+    def _canonical_snapshots(self, host_id: int, snapshots: dict) -> dict:
+        """Remap a worker's snapshot task ids onto THIS coordinator's
+        vertex ids via operator uids, so one checkpoint never mixes two
+        processes' generated ids for the same operator."""
+        with self._lock:
+            w = self._workers.get(host_id)
+            worker_uids = dict(w.uids) if w and w.uids else {}
+        if not worker_uids:
+            return snapshots
+        uid_to_canonical = {uid: vid for vid, uid in self._vertex_uids.items()}
+        out = {}
+        for task_id, snap in snapshots.items():
+            vid, sub = task_id.rsplit("#", 1)
+            uid = worker_uids.get(vid)
+            canonical = uid_to_canonical.get(uid, vid) if uid else vid
+            out[f"{canonical}#{sub}"] = snap
+        return out
+
     def _on_ack(self, msg: dict) -> None:
         cid = msg["checkpoint_id"]
         complete = None
+        snapshots = self._canonical_snapshots(msg["host_id"],
+                                              msg["snapshots"])
         with self._lock:
             if cid not in self._pending_acks:
                 return
-            self._pending_acks[cid].update(msg["snapshots"])
+            self._pending_acks[cid].update(snapshots)
             self._pending_hosts[cid].discard(msg["host_id"])
             if not self._pending_hosts[cid]:
                 complete = CompletedCheckpoint(
@@ -218,10 +270,79 @@ class _Coordinator:
             self.broadcast({"type": "checkpoint_complete",
                             "checkpoint_id": cid})
 
+    # -- failover ----------------------------------------------------------
+    def _maybe_restart(self, dead: list[int], reason: str) -> bool:
+        """Redeploy the job over the surviving workers from the latest
+        completed checkpoint (reference region failover collapsed to
+        whole-job: every surviving host restarts its subtasks; the dead
+        host's subtasks move to survivors via the shared placement
+        function). Returns False when the strategy is exhausted/disabled —
+        caller falls back to fail+cancel. The actual restart runs on its
+        own thread: it first waits out the heartbeat window so 'which
+        hosts are alive' is settled truth, not a race with the failure
+        report (a task failure often precedes the peer's heartbeat
+        expiry)."""
+        with self._lock:
+            if self._restart_inflight:
+                return True  # a restart is already being arranged
+            self._strategy.notify_failure()
+            if not self._strategy.can_restart():
+                return False
+            self._restart_inflight = True
+        threading.Thread(target=self._do_restart, args=(list(dead), reason),
+                         name="coord-restart", daemon=True).start()
+        return True
+
+    def _do_restart(self, dead: list[int], reason: str) -> None:
+        grace = max(self._strategy.backoff_seconds(), self._hb_timeout)
+        time.sleep(grace)
+        now = time.time()
+        with self._lock:
+            stale = [w.host_id for w in self._workers.values()
+                     if not w.finished
+                     and now - w.last_heartbeat > self._hb_timeout]
+            for d in set(dead) | set(stale):
+                w = self._workers.pop(d, None)
+                if w is not None:
+                    try:
+                        w.sock.close()
+                    except OSError:
+                        pass
+            live = sorted(self._workers)
+            if not live:
+                self._restart_inflight = False
+                self.failed = f"{reason}; no surviving workers"
+                self.broadcast({"type": "cancel"})
+                return
+            self.epoch += 1
+            self.restarts += 1
+            epoch = self.epoch
+            self._expected = set(live)
+            self._all_done_sent = False
+            self._pending_acks.clear()
+            self._pending_hosts.clear()
+            for w in self._workers.values():
+                w.finished = False
+            cp = self.completed[-1] if self.completed else None
+            self._restart_inflight = False
+        msg = {"type": "restart", "epoch": epoch, "live_hosts": live,
+               "reason": reason, "checkpoint_path": None, "checkpoint": None}
+        if cp is not None:
+            if cp.external_path:
+                msg["checkpoint_path"] = cp.external_path
+            else:
+                msg["checkpoint"] = cp  # in-memory storage: ship it inline
+        self.broadcast(msg)
+
     # -- liveness ----------------------------------------------------------
     def monitor(self, heartbeat_timeout: float) -> None:
         """Heartbeat-timeout failure detection (reference
-        HeartbeatManagerImpl); marks the job failed and cancels."""
+        HeartbeatManagerImpl): a dead worker triggers redeploy-from-
+        checkpoint under the configured restart strategy, job failure
+        when restarts are disabled/exhausted. Also announces global
+        completion (all_done) so workers that finished early stay
+        available for failover until the whole job is done."""
+        self._hb_timeout = heartbeat_timeout
         while not self._stop.is_set():
             time.sleep(heartbeat_timeout / 3)
             now = time.time()
@@ -230,12 +351,20 @@ class _Coordinator:
                         if not w.finished
                         and now - w.last_heartbeat > heartbeat_timeout]
             if dead and self.failed is None:
-                self.failed = f"worker(s) {dead} missed heartbeats"
-                self.broadcast({"type": "cancel"})
+                if not self._maybe_restart(
+                        dead, f"worker(s) {dead} missed heartbeats"):
+                    self.failed = f"worker(s) {dead} missed heartbeats"
+                    self.broadcast({"type": "cancel"})
+            if self.all_finished():
+                with self._lock:
+                    send = not self._all_done_sent
+                    self._all_done_sent = True
+                if send:
+                    self.broadcast({"type": "all_done"})
 
     def all_finished(self) -> bool:
         with self._lock:
-            return (len(self._workers) == self.n_hosts
+            return (set(self._workers) >= self._expected
                     and all(w.finished for w in self._workers.values()))
 
     def close(self) -> None:
@@ -267,22 +396,44 @@ class DistributedHost:
         self._ctrl: Optional[socket.socket] = None
         self.job: Optional[LocalJob] = None
         self._cancelled = threading.Event()
+        # failover state: the control loop records a restart order and the
+        # run loop redeploys; all_done releases finished workers
+        self._restart_intent: Optional[dict] = None
+        self._restart_event = threading.Event()
+        self._all_done = threading.Event()
+        self._redeploying = threading.Event()
+        self._pending_ckpts: dict[int, tuple[int, bool]] = {}
+        self._intent_lock = threading.Lock()
+        # control-socket sends originate from the heartbeat thread, the
+        # checkpoint listener AND the run loop: serialize the frames
+        self._ctrl_lock = threading.Lock()
 
     @property
     def data_address(self) -> tuple[str, int]:
         return self.transport.host, self.transport.port
 
     # -- deployment --------------------------------------------------------
-    def deploy(self, peer_data_addrs: dict[int, tuple[str, int]]) -> LocalJob:
+    def deploy(self, peer_data_addrs: dict[int, tuple[str, int]],
+               live_hosts: Optional[list[int]] = None, epoch: int = 0,
+               restored: Optional[dict] = None) -> LocalJob:
         """Instantiate ONLY this host's subtasks; wire cross-host edges
         through the transport (the Execution.deploy analog, but locality-
-        filtered by the shared placement function)."""
+        filtered by the shared placement function). ``live_hosts`` narrows
+        placement to the surviving hosts after a failover (a dead host's
+        subtasks move to survivors deterministically); ``epoch`` tags the
+        transport streams so a restarted deployment never reads a previous
+        attempt's in-flight data; ``restored`` maps task ids to checkpoint
+        snapshots."""
         jg, config = self.jg, self.config
         job = LocalJob(jg, config)
         aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
+        live = live_hosts or list(range(self.n_hosts))
+
+        def place(sub: int) -> int:
+            return live[sub % len(live)]
 
         def edge_key(ei: int, src_sub: int, dst_sub: int) -> str:
-            return f"e{ei}:{src_sub}:{dst_sub}"
+            return f"E{epoch}:e{ei}:{src_sub}:{dst_sub}"
 
         # channels for edges touching this host
         channels: dict[tuple[int, int, int], Any] = {}
@@ -291,13 +442,12 @@ class DistributedHost:
             dst_v = jg.vertices[e.target_vertex]
             for s in range(src_v.parallelism):
                 for d in range(dst_v.parallelism):
-                    s_here = subtask_host(s, self.n_hosts) == self.host_id
-                    d_here = subtask_host(d, self.n_hosts) == self.host_id
+                    s_here = place(s) == self.host_id
+                    d_here = place(d) == self.host_id
                     if s_here and d_here:
                         channels[(ei, s, d)] = LocalChannel()
                     elif s_here:
-                        dst_host = subtask_host(d, self.n_hosts)
-                        host, port = peer_data_addrs[dst_host]
+                        host, port = peer_data_addrs[place(d)]
                         channels[(ei, s, d)] = RemoteChannelSender(
                             host, port, edge_key(ei, s, d))
                     elif d_here:
@@ -311,7 +461,7 @@ class DistributedHost:
             in_edges = [(ei, e) for ei, e in enumerate(jg.edges)
                         if e.target_vertex == vid]
             for sub in range(vertex.parallelism):
-                if subtask_host(sub, self.n_hosts) != self.host_id:
+                if place(sub) != self.host_id:
                     continue
                 task_id = f"{vid}#{sub}"
                 ctx = OperatorContext(
@@ -386,10 +536,38 @@ class DistributedHost:
                         ops, ctx, task.make_tail_output(),
                         side_outputs=_side_outputs_map(side_writers, None))
                 job.tasks[task_id] = task
+                if restored:
+                    snap = restored.get(task_id)
+                    if snap:
+                        task.restore_state(snap)
         self.job = job
         return job
 
     # -- control-plane client ---------------------------------------------
+    def _uid_map(self) -> dict:
+        return {vid: v.uid for vid, v in self.jg.vertices.items() if v.uid}
+
+    def _ctrl_send(self, msg: dict) -> None:
+        with self._ctrl_lock:
+            _send_msg(self._ctrl, msg)
+
+    def _max_restart_wait(self) -> float:
+        """Upper bound on how long the coordinator may take to broadcast a
+        restart order: its grace = max(strategy backoff, heartbeat window),
+        both derivable from the shared SPMD config."""
+        cfg = self.config
+        kind = cfg.get(RuntimeOptions.RESTART_STRATEGY)
+        if kind == "fixed-delay":
+            backoff = cfg.get(RuntimeOptions.RESTART_DELAY)
+        elif kind == "exponential-delay":
+            backoff = cfg.get(RuntimeOptions.BACKOFF_MAX)
+        elif kind == "failure-rate":
+            backoff = cfg.get(RuntimeOptions.FAILURE_RATE_DELAY)
+        else:
+            backoff = 0.0
+        hb = 3 * cfg.get(RuntimeOptions.HEARTBEAT_INTERVAL) + 2.0
+        return max(backoff, hb) + 10.0
+
     def _connect_control(self) -> None:
         host, port = self._coord_addr.split(":")
         deadline = time.time() + 30
@@ -402,33 +580,36 @@ class DistributedHost:
                 if time.time() >= deadline:
                     raise
                 time.sleep(0.1)
-        _send_msg(self._ctrl, {"type": "register",
-                               "host_id": self.host_id})
+        self._ctrl_send({"type": "register", "host_id": self.host_id,
+                         "uids": self._uid_map()})
         threading.Thread(target=self._control_loop, name="worker-control",
                          daemon=True).start()
         threading.Thread(target=self._heartbeat_loop,
                          name="worker-heartbeat", daemon=True).start()
 
-    def _control_loop(self) -> None:
+    def _make_listener(self):
         acks: dict[int, dict] = {}
-        pending: dict[int, tuple[int, bool]] = {}  # cid -> (await_n, sp)
+        self._pending_ckpts: dict[int, tuple[int, bool]] = {}
+        pending = self._pending_ckpts  # cid -> (await_n, sp)
 
         def listener(kind, task_id, cid, payload):
             if kind == "ack":
                 acks.setdefault(cid, {})[task_id] = payload
                 if cid in pending and len(acks[cid]) == pending[cid][0]:
-                    _send_msg(self._ctrl, {
+                    self._ctrl_send({
                         "type": "ack", "host_id": self.host_id,
                         "checkpoint_id": cid,
                         "savepoint": pending[cid][1],
                         "snapshots": acks.pop(cid)})
                     del pending[cid]
             else:
-                _send_msg(self._ctrl, {"type": "decline",
-                                       "host_id": self.host_id,
-                                       "checkpoint_id": cid})
+                self._ctrl_send({"type": "decline",
+                                 "host_id": self.host_id,
+                                 "checkpoint_id": cid})
 
-        self.job.checkpoint_listener = listener
+        return listener
+
+    def _control_loop(self) -> None:
         try:
             while not self._cancelled.is_set():
                 msg = _recv_msg(self._ctrl)
@@ -436,8 +617,15 @@ class DistributedHost:
                     return
                 if msg["type"] == "trigger_checkpoint":
                     cid = msg["checkpoint_id"]
+                    if self._redeploying.is_set() or self.job is None:
+                        # mid-failover: this attempt cannot snapshot
+                        self._ctrl_send({"type": "decline",
+                                         "host_id": self.host_id,
+                                         "checkpoint_id": cid})
+                        continue
                     from ..core.elements import CheckpointBarrier
-                    pending[cid] = (len(self.job.tasks), msg["savepoint"])
+                    self._pending_ckpts[cid] = (len(self.job.tasks),
+                                                msg["savepoint"])
                     barrier = CheckpointBarrier(
                         cid, is_savepoint=msg["savepoint"])
                     for t in self.job.source_tasks.values():
@@ -449,9 +637,19 @@ class DistributedHost:
                             lambda t=t, c=cid:
                             t.chain.notify_checkpoint_complete(c)
                             if getattr(t, "chain", None) else None)
+                elif msg["type"] == "restart":
+                    with self._intent_lock:
+                        self._restart_intent = msg
+                    self._redeploying.set()
+                    self._restart_event.set()
+                    if self.job is not None:
+                        self.job.cancel()
+                elif msg["type"] == "all_done":
+                    self._all_done.set()
                 elif msg["type"] == "cancel":
                     self._cancelled.set()
-                    self.job.cancel()
+                    if self.job is not None:
+                        self.job.cancel()
         except OSError:
             pass
 
@@ -459,16 +657,36 @@ class DistributedHost:
         interval = self.config.get(RuntimeOptions.HEARTBEAT_INTERVAL)
         while not self._cancelled.is_set():
             try:
-                _send_msg(self._ctrl, {"type": "heartbeat",
-                                       "host_id": self.host_id})
+                self._ctrl_send({"type": "heartbeat",
+                                 "host_id": self.host_id})
             except OSError:
                 return
             time.sleep(interval)
 
     # -- run ---------------------------------------------------------------
+    def _load_restore_map(self, intent: dict) -> Optional[dict]:
+        """task_id -> snapshot for a restart order (checkpoint shipped
+        inline for in-memory storage, loaded from shared storage by path
+        otherwise; None = restart from scratch)."""
+        cp = intent.get("checkpoint")
+        path = intent.get("checkpoint_path")
+        if cp is None and path:
+            cp = FsCheckpointStorage(
+                str(path).rsplit("/", 1)[0]).load(path)
+        if cp is None:
+            return None
+        from ..checkpoint.coordinator import build_restore_map
+
+        return build_restore_map(cp, self.jg)
+
     def run(self, peer_data_addrs: dict[int, tuple[str, int]],
             timeout: Optional[float] = 300.0) -> LocalJob:
-        job = self.deploy(peer_data_addrs)
+        deadline = (time.time() + timeout) if timeout else None
+
+        def remaining() -> Optional[float]:
+            return None if deadline is None else max(deadline - time.time(),
+                                                     0.01)
+
         if self.coordinator is not None and self._coord_addr is None:
             # host 0 participates as a worker too, over loopback — its task
             # acks flow through the same control path as everyone else's
@@ -491,16 +709,84 @@ class DistributedHost:
                         self.coordinator.trigger_checkpoint()
                 threading.Thread(target=periodic, name="coord-periodic",
                                  daemon=True).start()
-        job.start()
+        restart_enabled = self.config.get(
+            RuntimeOptions.RESTART_STRATEGY) != "none"
+        live = sorted(peer_data_addrs)
+        epoch, restored = 0, None
+        job = None
         try:
-            job.wait(timeout)
-        finally:
-            if self._ctrl is not None:
+            while True:
+                self._restart_event.clear()
+                with self._intent_lock:
+                    intent = self._restart_intent
+                    self._restart_intent = None
+                if intent is not None:
+                    if job is not None:
+                        for t in job.tasks.values():
+                            t.cancel()
+                        for t in job.tasks.values():
+                            t.join(5.0)
+                    epoch = intent["epoch"]
+                    live = [h for h in intent["live_hosts"]
+                            if h in peer_data_addrs]
+                    if self.host_id not in live:
+                        break
+                    restored = self._load_restore_map(intent)
+                job = self.deploy(peer_data_addrs, live_hosts=live,
+                                  epoch=epoch, restored=restored)
+                job.checkpoint_listener = self._make_listener()
+                self._redeploying.clear()
+                if epoch > 0 and self._ctrl is not None:
+                    # announce readiness for the new attempt
+                    self._ctrl_send({"type": "register",
+                                     "host_id": self.host_id,
+                                     "uids": self._uid_map()})
+                job.start()
                 try:
-                    _send_msg(self._ctrl, {"type": "finished",
-                                           "host_id": self.host_id})
-                except OSError:
-                    pass
+                    job.wait(remaining())
+                except TimeoutError:
+                    raise
+                except RuntimeError as e:
+                    if self._restart_intent is None:
+                        # a genuine task failure on THIS host: report it;
+                        # the coordinator decides restart vs fail
+                        if restart_enabled and self._ctrl is not None:
+                            try:
+                                self._ctrl_send({"type": "failed",
+                                                 "host_id": self.host_id,
+                                                 "epoch": epoch,
+                                                 "error": str(e)})
+                            except OSError:
+                                raise e
+                            wait_s = self._max_restart_wait()
+                            if remaining() is not None:
+                                wait_s = min(wait_s, remaining())
+                            if not self._restart_event.wait(wait_s):
+                                raise
+                        else:
+                            raise
+                if self._cancelled.is_set():
+                    break
+                if self._restart_intent is not None:
+                    continue
+                # finished this attempt normally
+                if self._ctrl is not None:
+                    try:
+                        self._ctrl_send({"type": "finished",
+                                         "host_id": self.host_id})
+                    except OSError:
+                        pass
+                if not restart_enabled or self._ctrl is None:
+                    break
+                # stay available for failover until the WHOLE job is done
+                while not (self._all_done.is_set()
+                           or self._cancelled.is_set()
+                           or self._restart_event.wait(0.05)):
+                    if deadline is not None and time.time() >= deadline:
+                        break
+                if self._restart_intent is None:
+                    break
+        finally:
             self._cancelled.set()
         return job
 

@@ -138,6 +138,10 @@ class StreamTask:
 
     # -- control -----------------------------------------------------------
     def start(self) -> threading.Thread:
+        # a cancelled task must unwind out of backpressured emits (failover
+        # teardown toward a dead peer)
+        for w in self.all_writers():
+            w.cancel_event = self._cancelled
         self._thread = threading.Thread(target=self._run_safely,
                                         name=self.task_id, daemon=True)
         self._thread.start()

@@ -32,7 +32,7 @@ __all__ = [
     "StreamPartitioner", "ForwardPartitioner", "RebalancePartitioner",
     "RescalePartitioner", "BroadcastPartitioner", "ShufflePartitioner",
     "GlobalPartitioner", "KeyGroupPartitioner", "CustomPartitioner",
-    "RecordWriter",
+    "RecordWriter", "WriterCancelled",
 ]
 
 
@@ -145,6 +145,13 @@ class CustomPartitioner(StreamPartitioner):
         return [(i, p) for i, p in enumerate(parts) if p.n]
 
 
+class WriterCancelled(Exception):
+    """Raised out of a blocked emit when the owning task is cancelled —
+    how a task stuck on backpressure toward a dead peer unwinds during
+    failover (reference: the availability future completing exceptionally
+    on cancellation)."""
+
+
 class RecordWriter:
     """Writes one operator output to its downstream channels."""
 
@@ -154,12 +161,14 @@ class RecordWriter:
         self.partitioner = partitioner
         self.subtask_index = subtask_index
         self._put_timeout = put_timeout
+        self.cancel_event = None  # set by the task that owns this writer
 
     def _put_blocking(self, channel: Channel, element: Any) -> None:
         # Bounded queue full = backpressure; spin with timeout so the task
         # thread stays interruptible (reference: availability future).
         while not channel.put(element, timeout=self._put_timeout):
-            pass
+            if self.cancel_event is not None and self.cancel_event.is_set():
+                raise WriterCancelled()
 
     def emit(self, batch: RecordBatch) -> None:
         if not batch.n:
