@@ -597,11 +597,16 @@ class WindowedStream:
                        capacity: int = 1 << 16, ring_size: int = 64,
                        device_batch: int = 1 << 12,
                        emit_window_bounds: bool = True,
+                       emit_topk: Optional[int] = None,
+                       async_fire: bool = False,
                        name: str = "MeshWindowAgg") -> DataStream:
         """Window aggregation as ONE mesh-sharded SPMD vertex: keyBy is the
         on-device all_to_all exchange, state is sharded by key-group range
         across the mesh (parallel/sharded_window.py). The vertex has host
-        parallelism 1 — its real parallelism is the device mesh."""
+        parallelism 1 — its real parallelism is the device mesh.
+        ``emit_topk``/``async_fire`` match device_aggregate: two-phase
+        global top-k ranked on the first aggregate, fires emitting
+        asynchronously with watermarks held behind them."""
         from ..runtime.operators.mesh_window import MeshWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("mesh aggregation needs a column key")
@@ -613,7 +618,8 @@ class WindowedStream:
                 assigner, key_col, aggs, n_devices=n_devices,
                 capacity=capacity, ring_size=ring_size,
                 device_batch=device_batch,
-                emit_window_bounds=emit_window_bounds, name=name)
+                emit_window_bounds=emit_window_bounds,
+                emit_topk=emit_topk, async_fire=async_fire, name=name)
 
         return self.keyed._one_input(name, factory, parallelism=1,
                                      key_extractor=self.keyed.key_extractor)

@@ -167,9 +167,15 @@ class _Coordinator:
                 if kind == "register":
                     host_id = msg["host_id"]
                     with self._lock:
-                        self._workers[host_id] = _WorkerState(
-                            host_id, conn, time.time(),
-                            uids=msg.get("uids") or {})
+                        prev = self._workers.get(host_id)
+                        w = _WorkerState(host_id, conn, time.time(),
+                                         uids=msg.get("uids") or {})
+                        if prev is not None and prev.sock is conn:
+                            # re-registration for a new attempt over the
+                            # SAME connection: keep the send lock — a
+                            # broadcast thread may already hold it
+                            w.send_lock = prev.send_lock
+                        self._workers[host_id] = w
                         self._all_done_sent = False
                 elif kind == "heartbeat":
                     with self._lock:
@@ -184,9 +190,13 @@ class _Coordinator:
                         self._pending_hosts.pop(msg["checkpoint_id"], None)
                 elif kind == "finished":
                     with self._lock:
-                        w = self._workers.get(msg["host_id"])
-                        if w:
-                            w.finished = True
+                        # a stale pre-restart completion must not mark the
+                        # redeployed attempt finished (it would fake
+                        # all_finished and stop checkpointing)
+                        if msg.get("epoch", self.epoch) == self.epoch:
+                            w = self._workers.get(msg["host_id"])
+                            if w:
+                                w.finished = True
                 elif kind == "failed":
                     with self._lock:
                         stale = (msg.get("epoch", 0) < self.epoch
@@ -617,8 +627,11 @@ class DistributedHost:
                     return
                 if msg["type"] == "trigger_checkpoint":
                     cid = msg["checkpoint_id"]
-                    if self._redeploying.is_set() or self.job is None:
-                        # mid-failover: this attempt cannot snapshot
+                    if (self._redeploying.is_set() or self.job is None
+                            or self.job._done.is_set()):
+                        # mid-failover or already finished: this attempt
+                        # cannot snapshot — decline so the pending
+                        # checkpoint never waits on us forever
                         self._ctrl_send({"type": "decline",
                                          "host_id": self.host_id,
                                          "checkpoint_id": cid})
@@ -773,7 +786,8 @@ class DistributedHost:
                 if self._ctrl is not None:
                     try:
                         self._ctrl_send({"type": "finished",
-                                         "host_id": self.host_id})
+                                         "host_id": self.host_id,
+                                         "epoch": epoch})
                     except OSError:
                         pass
                 if not restart_enabled or self._ctrl is None:

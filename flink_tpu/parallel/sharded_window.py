@@ -99,6 +99,7 @@ class ShardedWindowAgg:
         self._step = self._build_step()
         self._fire = self._build_fire()
         self._retire = self._build_retire()
+        self._fire_variants: dict = {}
 
     # ------------------------------------------------------------------
     def init_state(self) -> ShardedWindowState:
@@ -209,6 +210,66 @@ class ShardedWindowAgg:
             rows_valid = np.ones(len(pane_rows), bool)
         return self._fire(state, jnp.asarray(pane_rows, jnp.int32),
                           jnp.asarray(rows_valid))
+
+    # ------------------------------------------------------------------
+    def _fire_full_program(self, rank_name: Optional[str],
+                           topk: Optional[int]):
+        # per-instance cache (module convention: _step/_fire built per
+        # instance) — an lru_cache on the method would pin replaced
+        # instances alive across _grow() rebuilds
+        key = (rank_name, topk)
+        cached = self._fire_variants.get(key)
+        if cached is not None:
+            return cached
+        prog = self._build_fire_full(rank_name, topk)
+        self._fire_variants[key] = prog
+        return prog
+
+    def _build_fire_full(self, rank_name: Optional[str],
+                         topk: Optional[int]):
+        """ONE compiled program for the whole fire (the mesh twin of
+        device_window._fire_program): pane merge for every aggregate +
+        emit mask + optional two-phase global top-k (per-shard lax.top_k,
+        merge of D*k candidates) + health scalars (max shard occupancy,
+        total drops) riding in the same outputs, so the hot loop never
+        pays a separate sync for pressure checks. Everything it returns is
+        materialized with ONE async device->host copy — never the full
+        [D, capacity] table when a top-k is requested."""
+        aggs = self.aggs
+        count_name = next(a.name for a in aggs if a.kind == "count")
+
+        @jax.jit
+        def fire(state: ShardedWindowState, pane_rows, rows_valid):
+            def merge(kind, arr):
+                sub = arr[:, pane_rows, :]              # [D, W, cap]
+                ident = AGG_INITS[kind](arr.dtype)
+                sub = jnp.where(rows_valid[None, :, None], sub, ident)
+                return AGG_MERGES[kind](sub, axis=1)
+
+            out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
+            count = out[count_name]
+            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+            occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
+            dropped = state.dropped.sum()
+            if topk is None:
+                return state.table, emit, out, dropped, occ
+            rank = out[rank_name]
+            _vals, flat_idx, ok = global_topk(rank, emit, topk)
+            keys = jnp.take(state.table.reshape(-1), flat_idx)
+            res = {n: jnp.take(v.reshape(-1), flat_idx)
+                   for n, v in out.items()}
+            return keys, ok, res, dropped, occ
+
+        return fire
+
+    def fire_compact(self, state: ShardedWindowState, pane_rows: np.ndarray,
+                     rows_valid: np.ndarray, rank_name: Optional[str],
+                     topk: Optional[int]):
+        """Dispatch the fused fire; returns device outputs (see
+        _fire_full_program) without synchronizing."""
+        return self._fire_full_program(rank_name, topk)(
+            state, jnp.asarray(pane_rows, jnp.int32),
+            jnp.asarray(rows_valid))
 
     # ------------------------------------------------------------------
     def _build_retire(self):

@@ -42,7 +42,7 @@ from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
 from ...state.tpu_backend import TpuKeyedStateBackend
 from ...window.assigners import WindowAssigner
 from .base import OneInputOperator, OperatorContext, Output
-from .slice_control import SliceControlPlane
+from .slice_control import AsyncFireQueue, SliceControlPlane
 
 __all__ = ["DeviceWindowAggOperator", "AggSpec"]
 
@@ -200,7 +200,8 @@ def _fire_program(agg_sig: tuple, topk: Optional[int]):
     return fire_fn
 
 
-class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
+class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
+                              OneInputOperator):
     def __init__(self, assigner: WindowAssigner, key_column: str,
                  aggs: Sequence[AggSpec],
                  capacity: int = 1 << 16,
@@ -252,7 +253,7 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         self._init_control_plane()
         if self._async:
             self._record_fire_latency = False
-        self._pending: deque = deque()
+        self._init_async_fires()
         self._fire_fn = None
         self._out_schema: Optional[Schema] = None
         self._late_dev = None  # device late-drop counter (device ingest)
@@ -539,17 +540,11 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         outs = fire_fn(self._backend.table, arrays,
                        jnp.asarray(pane_rows), jnp.asarray(rows_valid),
                        self._backend.dropped_device)
-        for leaf in jax.tree_util.tree_leaves(outs):
-            leaf.copy_to_host_async()
         # the host spill tier's rows merge at materialization; take them
         # NOW (before this fire retires the pane row below)
         host_part = (self._host_fire_part(np.array(rows, np.int32))
                      if self._backend.spill_active else None)
-        item = (p_end, outs, host_part, time.perf_counter())
-        if self._async:
-            self._pending.append(item)
-        else:
-            self._materialize(item)
+        self._enqueue_fire((p_end, outs, host_part, time.perf_counter()))
         # retire the oldest pane of this window: no future window needs it
         # (skip panes below min_seen — their ring rows belong to live panes)
         if p_end - W >= self._min_seen_pane:
@@ -620,11 +615,6 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         self._note_latency(t0)
         self.stage_s["drain"] += time.perf_counter() - t_drain
 
-    def _note_latency(self, t0: float) -> None:
-        from .slice_control import _MAX_FIRE_SAMPLES
-        if self._async and len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES:
-            self.fire_latencies_ms.append((time.perf_counter() - t0) * 1e3)
-
     def _emit_rows(self, p_end: int, keys: np.ndarray,
                    results: dict[str, np.ndarray]) -> None:
         n = len(keys)
@@ -642,28 +632,6 @@ class DeviceWindowAggOperator(SliceControlPlane, OneInputOperator):
         schema = Schema(fields)
         ts = np.full(n, end - 1, np.int64)
         self.output.emit(RecordBatch(schema, cols, ts))
-
-    # -- async emission queue ----------------------------------------------
-    def _drain(self, block: bool = False) -> None:
-        while self._pending:
-            head = self._pending[0]
-            if isinstance(head, Watermark):
-                self.output.emit_watermark(head)
-                self._pending.popleft()
-                continue
-            _p_end, outs, _hp, _t0 = head
-            if not block and not all(
-                    leaf.is_ready()
-                    for leaf in jax.tree_util.tree_leaves(outs)):
-                return
-            self._pending.popleft()
-            self._materialize(head)
-
-    def _emit_watermark_out(self, watermark: Watermark) -> None:
-        if self._async and self._pending:
-            self._pending.append(watermark)
-        else:
-            self.output.emit_watermark(watermark)
 
     def finish(self) -> None:
         self._drain(block=True)

@@ -20,6 +20,7 @@ Subclasses provide:
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
@@ -27,9 +28,63 @@ import numpy as np
 from ...core.elements import Watermark
 from ...core.records import RecordBatch
 
-__all__ = ["SliceControlPlane"]
+__all__ = ["SliceControlPlane", "AsyncFireQueue"]
 
 _MAX_FIRE_SAMPLES = 65536
+
+
+class AsyncFireQueue:
+    """Asynchronous fire emission, shared by the single-chip and mesh
+    device operators: a fire's compiled outputs start copying device->host
+    at dispatch (copy_to_host_async); emission is queued and drained once
+    the copy lands, and watermarks are held behind their fires so they
+    never overtake results downstream. The hot loop never blocks on a
+    fire. Subclasses implement ``_materialize(item)``; an item is a tuple
+    whose second element is the fire's device-output pytree."""
+
+    _async: bool
+
+    def _init_async_fires(self) -> None:
+        self._pending: deque = deque()
+
+    def _enqueue_fire(self, item: tuple) -> None:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(item[1]):
+            leaf.copy_to_host_async()
+        if self._async:
+            self._pending.append(item)
+        else:
+            self._materialize(item)
+
+    def _drain(self, block: bool = False) -> None:
+        import jax
+
+        while self._pending:
+            head = self._pending[0]
+            if isinstance(head, Watermark):
+                self.output.emit_watermark(head)
+                self._pending.popleft()
+                continue
+            if not block and not all(
+                    leaf.is_ready()
+                    for leaf in jax.tree_util.tree_leaves(head[1])):
+                return
+            self._pending.popleft()
+            self._materialize(head)
+
+    def _emit_watermark_out(self, watermark: Watermark) -> None:
+        if self._async and self._pending:
+            self._pending.append(watermark)
+        else:
+            self.output.emit_watermark(watermark)
+
+    def _note_latency(self, t0: float) -> None:
+        if self._async and len(self.fire_latencies_ms) < _MAX_FIRE_SAMPLES:
+            self.fire_latencies_ms.append((time.perf_counter() - t0) * 1e3)
+
+    def _materialize(self, item: tuple) -> None:
+        raise NotImplementedError
 
 
 class SliceControlPlane:
