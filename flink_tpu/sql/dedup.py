@@ -6,6 +6,19 @@ keep="first": emit only the first row per key (append-only output).
 keep="last": emit a changelog — +I for a key's first row, then -U(prev)/+U
 (new) as later rows replace it (the reference's keep-last with
 generateUpdateBefore=true).
+
+``ttl_ms`` bounds how long a key stays deduplicated (the reference's
+table.exec.state.ttl): a key re-admits after the TTL passes.
+
+With the "tpu" state backend and an integer key column, keep-first runs
+on DEVICE: the whole batch is one fused admission program on the keyed
+backend's typed row plane (hash lookup-or-insert + presence/TTL check +
+first-in-batch resolution — TpuKeyedStateBackend.dedup_first_batch), so
+dedup state lives in HBM and scales with the hash table, not a Python
+dict. Device TTL is batch-granular: duplicates within one micro-batch
+always deduplicate even across a TTL boundary (a batch spans
+microseconds; TTLs span seconds). keep="last" needs previous row VALUES
+for retractions and stays on the host plane.
 """
 
 from __future__ import annotations
@@ -22,18 +35,68 @@ from . import rowkind as rk
 __all__ = ["DeduplicateOperator"]
 
 
-
 class DeduplicateOperator(OneInputOperator):
     def __init__(self, key_index: int, keep: str = "first",
+                 ttl_ms: Optional[int] = None,
                  name: str = "Deduplicate"):
         super().__init__(name)
         if keep not in ("first", "last"):
             raise ValueError("keep must be 'first' or 'last'")
         self.key_index = key_index
         self.keep = keep
-        # kg -> key -> stored row (keep=last) / True (keep=first)
+        self.ttl_ms = int(ttl_ms) if ttl_ms else 0
+        # host plane: kg -> key -> (admit_ts, row-or-True)
         self._state: dict[int, dict[Any, Any]] = {}
         self._out_schema: Optional[Schema] = None
+        self._backend = None          # device plane (tpu backend)
+        self._device_checked = False
+
+    # -- device routing ----------------------------------------------------
+    def _build_backend(self):
+        b = self.ctx.create_keyed_backend()
+        b.register_row_state("__seen__", np.int8, self.ttl_ms or None)
+        if self._restored_device:
+            b.restore(self._restored_device)
+            self._restored_device = []
+        if self._state:
+            # host-plane entries restored from a hashmap-backend
+            # checkpoint migrate into the device presence plane
+            keys, admit_ts = [], []
+            for kmap in self._state.values():
+                for k, entry in kmap.items():
+                    keys.append(int(k))
+                    admit_ts.append(int(entry[0]))
+            b.rows_upsert("__seen__", np.asarray(keys, np.int64),
+                          np.ones(len(keys), np.int8),
+                          now_ms=np.asarray(admit_ts, np.int64))
+            self._state = {}
+        return b
+
+    def _device_backend(self, schema: Schema):
+        """The tpu keyed backend when this operator can run its admission
+        on device (keep-first + tpu backend + integer key column)."""
+        if self._device_checked:
+            return self._backend
+        self._device_checked = True
+        eligible = self.keep == "first"
+        if eligible:
+            from ..core.config import StateOptions
+            eligible = self.ctx.config.get(StateOptions.BACKEND) == "tpu"
+        if eligible:
+            key_field = schema.fields[self.key_index]
+            eligible = (key_field.dtype is not object and np.issubdtype(
+                np.dtype(key_field.dtype), np.integer))
+        if not eligible:
+            if self._restored_device:
+                raise RuntimeError(
+                    "dedup state was checkpointed on the tpu backend but "
+                    "this run cannot use the device path (backend/keep/"
+                    "key-dtype changed); restore with the original config")
+            return None
+        self._backend = self._build_backend()
+        return self._backend
+
+    _restored_device: list = ()
 
     def _ensure_schema(self, in_schema: Schema) -> Schema:
         if self._out_schema is None:
@@ -50,11 +113,28 @@ class DeduplicateOperator(OneInputOperator):
         schema = self._ensure_schema(batch.schema)
         names = [f.name for f in batch.schema.fields
                  if f.name != rk.ROWKIND_COLUMN]
-        cols = [batch.column(n) for n in names]
         kinds = (batch.column(rk.ROWKIND_COLUMN).astype(np.int8)
                  if rk.ROWKIND_COLUMN in batch.schema
                  else np.zeros(batch.n, np.int8))
+        retract = np.isin(kinds, (rk.UPDATE_BEFORE, rk.DELETE))
+        backend = self._device_backend(batch.schema)
+        if backend is not None:
+            # DEVICE keep-first: one fused admission program per batch
+            keys = batch.column(names[self.key_index]).astype(np.int64)
+            fresh = backend.dedup_first_batch(
+                "__seen__", keys, batch.timestamps, valid=~retract)
+            if fresh.any():
+                self.output.emit(RecordBatch(
+                    schema, {n: batch.column(n)[fresh] for n in names},
+                    batch.timestamps[fresh]))
+            return
+        self._process_host(batch, schema, names, kinds)
+
+    def _process_host(self, batch: RecordBatch, schema: Schema,
+                      names: list, kinds: np.ndarray) -> None:
+        cols = [batch.column(n) for n in names]
         ts_arr = batch.timestamps
+        ttl = self.ttl_ms
         out_rows, out_ts = [], []
         for i in range(batch.n):
             row = tuple(_scalar(c[i]) for c in cols)
@@ -66,19 +146,28 @@ class DeduplicateOperator(OneInputOperator):
             if self.keep == "first":
                 # keep-first assumes append-only input (like the reference's
                 # KeepFirstRowFunction); retractions are ignored
-                if not retract and key not in kmap:
-                    kmap[key] = True
+                if retract:
+                    continue
+                entry = kmap.get(key)
+                expired = (entry is not None and ttl
+                           and ts - entry[0] > ttl)
+                if entry is None or expired:
+                    kmap[key] = (ts, True)
                     out_rows.append(row)
                     out_ts.append(ts)
             elif retract:
                 # retraction of the current row deletes the key's entry
-                if kmap.get(key) == row:
+                entry = kmap.get(key)
+                if entry is not None and entry[1] == row:
                     del kmap[key]
                     out_rows.append(row + (int(rk.DELETE),))
                     out_ts.append(ts)
             else:
-                prev = kmap.get(key)
-                kmap[key] = row
+                entry = kmap.get(key)
+                prev = entry[1] if entry is not None else None
+                if entry is not None and ttl and ts - entry[0] > ttl:
+                    prev = None
+                kmap[key] = (ts, row)
                 if prev is None:
                     out_rows.append(row + (int(rk.INSERT),))
                     out_ts.append(ts)
@@ -91,13 +180,31 @@ class DeduplicateOperator(OneInputOperator):
             self.output.emit(RecordBatch.from_rows(schema, out_rows, out_ts))
 
     def snapshot_state(self, checkpoint_id: int) -> dict:
-        return {"keyed": {"backend": {"dedup": {
+        if self._backend is not None:
+            return {"keyed": {"backend": self._backend.snapshot(
+                checkpoint_id)}}
+        return {"keyed": {"backend": {"dedup2": {
             kg: dict(m) for kg, m in self._state.items()}}}}
 
     def initialize_state(self, keyed_snapshots: list,
                          operator_snapshot) -> None:
+        device_snaps = []
         for snap in keyed_snapshots:
-            for kg, entries in snap.get("backend", {}).get("dedup",
-                                                           {}).items():
+            table = snap.get("backend", {})
+            if table.get("kind") == "tpu":
+                device_snaps.append(table)
+                continue
+            for kg, entries in table.get("dedup2", {}).items():
                 if kg in self.ctx.key_group_range:
                     self._state.setdefault(kg, {}).update(entries)
+            for kg, entries in table.get("dedup", {}).items():
+                # pre-TTL snapshot format: entries lack the admit ts
+                if kg in self.ctx.key_group_range:
+                    self._state.setdefault(kg, {}).update(
+                        {k: (0, v) for k, v in entries.items()})
+        if device_snaps:
+            # build + restore EAGERLY: a checkpoint taken before the first
+            # batch must re-emit this state, not an empty host plane
+            self._restored_device = device_snaps
+            self._backend = self._build_backend()
+            self._device_checked = True

@@ -224,7 +224,8 @@ class IntervalJoinOperator(TwoInputOperator):
 
     def __init__(self, key_index1: int, key_index2: int, lower_ms: int,
                  upper_ms: int, out_schema: Schema,
-                 join_type: str = "inner", name: str = "IntervalJoin"):
+                 join_type: str = "inner", rows_per_key: int = 256,
+                 name: str = "IntervalJoin"):
         super().__init__(name)
         if join_type != "inner":
             raise NotImplementedError(
@@ -234,8 +235,16 @@ class IntervalJoinOperator(TwoInputOperator):
         self.lower = lower_ms
         self.upper = upper_ms
         self.out_schema = out_schema
-        # kg -> key -> list[(ts, row)] per side
+        self.rows_per_key = int(rows_per_key)
+        # host plane: kg -> key -> list[(ts, row)] per side
         self.buffers: tuple[dict, dict] = ({}, {})
+        # device plane (tpu backend + numeric schemas): per-side
+        # DeviceListStore — each side's buffered rows live in HBM and a
+        # probe batch is ONE lookup+gather; see state/device_lists.py
+        self._stores: list = [None, None]
+        self._schemas: list = [None, None]
+        self._device: Optional[bool] = None
+        self._restored_device: dict = {}
 
     def process_batch1(self, batch: RecordBatch) -> None:
         self._process(0, batch)
@@ -249,8 +258,60 @@ class IntervalJoinOperator(TwoInputOperator):
             return ts + self.lower, ts + self.upper
         return ts - self.upper, ts - self.lower
 
+    # -- device routing ----------------------------------------------------
+    def _device_eligible(self, schema: Schema, side: int) -> bool:
+        if self._device is False:
+            return False
+        if self._device and self._stores[side] is not None:
+            return True   # established; skip the per-batch scan
+        from ..core.config import StateOptions
+        if self.ctx.config.get(StateOptions.BACKEND) != "tpu":
+            self._device = False
+            return False
+        if self.buffers[0] or self.buffers[1]:
+            # host-plane buffers restored from a hashmap-backend
+            # checkpoint: heterogeneous rows can't migrate to the packed
+            # device lists without their schemas — keep plane continuity
+            self._device = False
+            return False
+        ok = all(f.dtype is not object and
+                 np.dtype(f.dtype).kind in "iufb" for f in schema.fields)
+        kf = schema.fields[self.key_idx[side]]
+        ok = ok and np.issubdtype(np.dtype(kf.dtype), np.integer)
+        if not ok:
+            if (self._stores[0] is not None or self._stores[1] is not None
+                    or self._restored_device):
+                raise TypeError(
+                    "interval join: device-plane state exists but this "
+                    "input is not device-eligible (non-numeric columns or "
+                    "non-integer key); use the hashmap backend")
+            self._device = False
+            return False
+        self._device = True
+        return True
+
+    def _store(self, side: int, schema: Schema):
+        if self._stores[side] is None:
+            from ..state.device_lists import DeviceListStore
+            self._schemas[side] = schema
+            snaps = self._restored_device.pop(side, None)
+            if snaps is not None:
+                # from_snapshots widens to the snapshot's row budget
+                self._stores[side] = DeviceListStore.from_snapshots(
+                    self.ctx.key_group_range, self.ctx.max_parallelism,
+                    snaps, rows_per_key=self.rows_per_key)
+            else:
+                self._stores[side] = DeviceListStore(
+                    self.ctx.key_group_range, self.ctx.max_parallelism,
+                    [np.dtype(f.dtype) for f in schema.fields],
+                    rows_per_key=self.rows_per_key)
+        return self._stores[side]
+
     def _process(self, side: int, batch: RecordBatch) -> None:
         if batch.n == 0:
+            return
+        if self._device_eligible(batch.schema, side):
+            self._process_device(side, batch)
             return
         names = [f.name for f in batch.schema.fields]
         cols = [batch.column(n) for n in names]
@@ -274,6 +335,51 @@ class IntervalJoinOperator(TwoInputOperator):
             self.output.emit(RecordBatch.from_rows(
                 self.out_schema, out_rows, out_ts))
 
+    def _other_store(self, side: int):
+        """The OTHER side's store — materialized from a restored snapshot
+        if that side hasn't seen a live batch yet."""
+        other = self._stores[1 - side]
+        if other is None and (1 - side) in self._restored_device:
+            from ..state.device_lists import DeviceListStore
+            other = DeviceListStore.from_snapshots(
+                self.ctx.key_group_range, self.ctx.max_parallelism,
+                self._restored_device.pop(1 - side),
+                rows_per_key=self.rows_per_key)
+            self._stores[1 - side] = other
+        return other
+
+    def _process_device(self, side: int, batch: RecordBatch) -> None:
+        """Batched probe of the other side's HBM lists + append of this
+        batch — two device programs and one transfer per batch, replacing
+        the per-record Python buffer walk."""
+        names = [f.name for f in batch.schema.fields]
+        keys = batch.column(names[self.key_idx[side]]).astype(np.int64)
+        ts = batch.timestamps
+        other = self._other_store(side)
+        if other is not None:
+            packed, counts = other.probe_batch(keys)       # [B, L, C], [B]
+            L = packed.shape[1]
+            ots = packed[:, :, 0]                          # [B, L]
+            live = np.arange(L)[None, :] < counts[:, None]
+            if side == 0:
+                lo, hi = ts + self.lower, ts + self.upper
+            else:
+                lo, hi = ts - self.upper, ts - self.lower
+            m = live & (ots >= lo[:, None]) & (ots <= hi[:, None])
+            bi, li = np.nonzero(m)
+            if len(bi):
+                mine = [batch.column(n)[bi] for n in names]
+                theirs = [other._unpack_col(packed[bi, li], i)
+                          for i in range(len(other.col_dtypes))]
+                ordered = mine + theirs if side == 0 else theirs + mine
+                out_cols = {f.name: c for f, c in
+                            zip(self.out_schema.fields, ordered)}
+                out_ts = np.maximum(ts[bi], ots[bi, li])
+                self.output.emit(RecordBatch(self.out_schema, out_cols,
+                                             out_ts))
+        self._store(side, batch.schema).append_batch(
+            keys, ts, [batch.column(n) for n in names])
+
     def process_watermark_n(self, input_index: int, watermark) -> None:
         super().process_watermark_n(input_index, watermark)
         wm = self.current_watermark
@@ -282,6 +388,9 @@ class IntervalJoinOperator(TwoInputOperator):
         keep_after = (wm - self.upper, wm + self.lower)
         for side in (0, 1):
             horizon = keep_after[side]
+            if self._stores[side] is not None:
+                self._stores[side].prune(horizon)   # device compaction
+                continue
             for kmap in self.buffers[side].values():
                 for key in list(kmap):
                     kept = [(t, r) for t, r in kmap[key] if t >= horizon]
@@ -291,6 +400,12 @@ class IntervalJoinOperator(TwoInputOperator):
                         del kmap[key]
 
     def snapshot_state(self, checkpoint_id: int) -> dict:
+        if self._device:
+            return {"keyed": {"backend": {
+                "list-left": (self._stores[0].snapshot()
+                              if self._stores[0] is not None else None),
+                "list-right": (self._stores[1].snapshot()
+                               if self._stores[1] is not None else None)}}}
         return {"keyed": {"backend": {
             "buf-left": {kg: {k: list(v) for k, v in m.items()}
                          for kg, m in self.buffers[0].items()},
@@ -301,6 +416,10 @@ class IntervalJoinOperator(TwoInputOperator):
                          operator_snapshot) -> None:
         for snap in keyed_snapshots:
             table = snap.get("backend", {})
+            for name, side in (("list-left", 0), ("list-right", 1)):
+                dsnap = table.get(name)
+                if dsnap is not None:
+                    self._restored_device.setdefault(side, []).append(dsnap)
             for name, side in (("buf-left", 0), ("buf-right", 1)):
                 for kg, kmap in table.get(name, {}).items():
                     if kg in self.ctx.key_group_range:
@@ -308,6 +427,16 @@ class IntervalJoinOperator(TwoInputOperator):
                         for k, rows in kmap.items():
                             tgt.setdefault(k, []).extend(
                                 (int(t), tuple(r)) for t, r in rows)
+        if self._restored_device:
+            # build stores EAGERLY: a checkpoint taken before the first
+            # batch must carry this state, not an empty host plane
+            from ..state.device_lists import DeviceListStore
+            for side in list(self._restored_device):
+                self._stores[side] = DeviceListStore.from_snapshots(
+                    self.ctx.key_group_range, self.ctx.max_parallelism,
+                    self._restored_device.pop(side),
+                    rows_per_key=self.rows_per_key)
+            self._device = True
 
 
 class LookupJoinOperator(OneInputOperator):

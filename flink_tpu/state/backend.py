@@ -205,8 +205,18 @@ def register_backend(name: str, factory: StateBackendFactory) -> None:
     _BACKENDS[name] = factory
 
 
+# built-in backends whose modules load on first use (the reference's
+# StateBackendLoader factory-class lookup, StateBackendLoader.java:113 —
+# the RocksDB backend is found by class name the same way)
+_LAZY_BACKENDS = {"tpu": "flink_tpu.state.tpu_backend"}
+
+
 def create_backend(name: str, key_group_range: KeyGroupRange,
                    max_parallelism: int, **kwargs) -> KeyedStateBackend:
+    if name not in _BACKENDS and name in _LAZY_BACKENDS:
+        import importlib
+
+        importlib.import_module(_LAZY_BACKENDS[name])  # registers itself
     if name not in _BACKENDS:
         if ":" in name:  # fully-qualified "module:attr" factory, plugin-style
             mod, attr = name.split(":", 1)

@@ -24,6 +24,7 @@ the host backend — the graph planner routes accordingly.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Iterable, Optional
 
 import jax
@@ -47,6 +48,76 @@ def _sanitize_keys(keys: np.ndarray) -> np.ndarray:
     """Remap the EMPTY sentinel (int64 max) to int64 max - 1."""
     return np.where(keys == np.int64(EMPTY_KEY), np.int64(EMPTY_KEY) - 1,
                     keys.astype(np.int64))
+
+
+# ----------------------------------------------------------------------
+# typed row-plane programs (batched per-key value access; see
+# TpuKeyedStateBackend.rows_* below). All scatters resolve duplicate keys
+# within a batch DETERMINISTICALLY (last occurrence wins for writes,
+# first occurrence admits for dedup) via first/last-position scatters.
+# ----------------------------------------------------------------------
+
+@jax.jit
+def _rows_set(vals, present, last_ts, slots, new_vals, now):
+    B = slots.shape[0]
+    cap = vals.shape[0]
+    widx = jnp.where(slots >= 0, slots, cap).astype(jnp.int32)
+    lastpos = jnp.full(cap + 1, -1, jnp.int32).at[widx].max(
+        jnp.arange(B, dtype=jnp.int32))
+    widx = jnp.where(jnp.arange(B, dtype=jnp.int32) == lastpos[widx],
+                     widx, cap)
+    vals = vals.at[widx].set(new_vals.astype(vals.dtype), mode="drop")
+    present = present.at[widx].set(jnp.int8(1), mode="drop")
+    if last_ts is not None:
+        last_ts = last_ts.at[widx].set(now, mode="drop")
+    return vals, present, last_ts
+
+
+@jax.jit
+def _rows_get(table, vals, present, last_ts, keys, now, ttl_ms):
+    slots = lookup(table, keys)
+    found = slots >= 0
+    sc = jnp.maximum(slots, 0)
+    p = (present[sc] > 0) & found
+    if last_ts is not None:
+        p = p & ((now - last_ts[sc]) <= ttl_ms)
+    return vals[sc], p
+
+
+@jax.jit
+def _rows_unset(table, present, keys):
+    slots = lookup(table, keys)
+    cap = present.shape[0]
+    widx = jnp.where(slots >= 0, slots, cap).astype(jnp.int32)
+    return present.at[widx].set(jnp.int8(0), mode="drop"), \
+        jnp.maximum(slots, 0)
+
+
+@jax.jit
+def _dedup_first(table, present, last_ts, keys, valid, ts, ttl_ms):
+    """Keep-first admission: fresh[i] iff row i is valid, its key admits
+    (absent / cleared / TTL-expired in state), and i is the key's first
+    occurrence in this batch. Presence is claimed for admitted keys; the
+    TTL clock refreshes on admission only (keep-first write semantics)."""
+    B = keys.shape[0]
+    cap = present.shape[0]
+    table, slots, ok = lookup_or_insert(table, keys, valid)
+    widx = jnp.where(ok, slots, cap).astype(jnp.int32)
+    firstpos = jnp.full(cap + 1, B, jnp.int32).at[widx].min(
+        jnp.arange(B, dtype=jnp.int32))
+    is_first = jnp.arange(B, dtype=jnp.int32) == firstpos[widx]
+    sc = jnp.maximum(slots, 0)
+    was = (present[sc] > 0) & ok
+    if last_ts is not None:
+        was = was & ((ts - last_ts[sc]) <= ttl_ms)
+    fresh = ok & ~was & is_first
+    present = present.at[widx].set(jnp.int8(1), mode="drop")
+    if last_ts is not None:
+        fidx = jnp.where(fresh, slots, cap).astype(jnp.int32)
+        last_ts = last_ts.at[fidx].set(ts, mode="drop")
+    overflow = jnp.any(valid & ~ok)
+    occ = (table != jnp.int64(EMPTY_KEY)).sum()
+    return table, present, last_ts, fresh, sc, overflow, occ
 
 
 class _ArrayState:
@@ -75,6 +146,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         self.table = make_table(cap)
         self._array_states: dict[str, _ArrayState] = {}
         self._row_states: dict[str, State] = {}
+        self._row_meta: dict[str, int] = {}  # row-plane name -> ttl_ms
         self._num_keys = 0  # host-tracked occupancy (exact: insert-only table)
         # deferred mode: the hot path never syncs with the host; overflow
         # accumulates in a device counter checked at watermark boundaries
@@ -601,18 +673,135 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         return self._num_keys
 
     # ------------------------------------------------------------------
+    # typed row plane: per-key values of ANY numeric dtype with presence
+    # bits and optional TTL, accessed in BATCHES (one lookup + one gather
+    # or scatter per batch — the per-key State handles below wrap this).
+    # ------------------------------------------------------------------
+    def register_row_state(self, name: str, dtype,
+                           ttl_ms: Optional[int] = None) -> None:
+        """Value plane [capacity] of ``dtype`` + presence int8 plane
+        (+ last-update int64 plane when a TTL is set: entries expire
+        ttl_ms after last update, checked lazily at read — the relaxed
+        cleanup of the reference's StateTtlConfig)."""
+        if name in self._row_meta:
+            return
+        self._row_meta[name] = (int(ttl_ms or 0),
+                                jnp.dtype(np.dtype(dtype)))
+        self._ensure_row_planes(name)
+
+    def _ensure_row_planes(self, name: str) -> None:
+        """(Re-)materialize a row state's planes; a restore() rebuilds
+        _array_states from the snapshot alone, so planes the snapshot
+        lacked (e.g. the TTL clock of a job upgraded from no-TTL) come
+        back here. A fresh TTL clock next to RESTORED presence fills with
+        int64 max: existing entries never expire rather than all expiring
+        at once."""
+        ttl, dtype = self._row_meta[name]
+        restored_presence = f"{name}.__set__" in self._array_states
+        self.register_array_state(name, "sum", dtype)
+        self.register_array_state(f"{name}.__set__", "sum", jnp.int8)
+        if ttl and f"{name}.__ts__" not in self._array_states:
+            self.register_array_state(f"{name}.__ts__", "sum", jnp.int64)
+            if restored_presence:
+                self.set_array(f"{name}.__ts__", jnp.full(
+                    self.capacity, np.iinfo(np.int64).max, jnp.int64))
+
+    def _row_planes(self, name: str):
+        ttl, _dtype = self._row_meta[name]
+        self._ensure_row_planes(name)
+        last = self.get_array(f"{name}.__ts__") if ttl else None
+        return (self.get_array(name), self.get_array(f"{name}.__set__"),
+                last, ttl)
+
+    def rows_upsert(self, name: str, keys: np.ndarray, values: np.ndarray,
+                    now_ms=0) -> None:
+        """Set values for a batch of keys (last occurrence wins for
+        duplicate keys, deterministically). One slot resolution + one
+        scatter program. ``now_ms`` may be a scalar or a per-row array
+        (TTL clock)."""
+        slots = self.slots_for_batch(np.asarray(keys))
+        vals, present, last, ttl = self._row_planes(name)
+        arrs = _rows_set(vals, present, last, slots,
+                         jnp.asarray(np.asarray(values)),
+                         jnp.asarray(np.asarray(now_ms, np.int64)))
+        self.set_array(name, arrs[0])
+        self.set_array(f"{name}.__set__", arrs[1])
+        if last is not None:
+            self.set_array(f"{name}.__ts__", arrs[2])
+
+    def rows_lookup(self, name: str, keys: np.ndarray,
+                    now_ms: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """(values, present) for a batch of keys — absent, cleared, or
+        TTL-expired keys report present=False. One lookup + one gather +
+        one transfer."""
+        vals, present, last, ttl = self._row_planes(name)
+        v, p = _rows_get(self.table, vals, present, last,
+                         jnp.asarray(_sanitize_keys(np.asarray(keys))),
+                         np.int64(now_ms), np.int64(ttl))
+        v, p = jax.device_get((v, p))
+        return np.asarray(v), np.asarray(p)
+
+    def rows_clear(self, name: str, keys: np.ndarray) -> None:
+        vals, present, last, _ttl = self._row_planes(name)
+        new_present, slots = _rows_unset(
+            self.table, present,
+            jnp.asarray(_sanitize_keys(np.asarray(keys))))
+        self.set_array(f"{name}.__set__", new_present)
+        self.mark_dirty(slots)
+
+    def dedup_first_batch(self, name: str, keys: np.ndarray,
+                          ts: np.ndarray,
+                          valid: Optional[np.ndarray] = None) -> np.ndarray:
+        """Keep-first admission for a batch: returns a bool mask of the
+        rows seen for the FIRST time (within the batch, against state, and
+        — under a TTL — since expiry). The whole batch is one fused
+        program; overflow grows the table and retries (sync-mode
+        semantics)."""
+        if name not in self._row_meta:
+            raise RuntimeError(f"row state {name!r} not registered")
+        keys = _sanitize_keys(np.asarray(keys))
+        dvalid = (jnp.asarray(np.asarray(valid, bool)) if valid is not None
+                  else jnp.ones(len(keys), bool))
+        dts = jnp.asarray(np.asarray(ts, np.int64))
+        while True:
+            _vals, present, last, ttl = self._row_planes(name)
+            table, new_present, new_last, fresh, slots, overflow, occ = \
+                _dedup_first(self.table, present, last, jnp.asarray(keys),
+                             dvalid, dts, np.int64(ttl))
+            fresh_h, overflow_h, occ_h = jax.device_get(
+                (fresh, overflow, occ))
+            if bool(overflow_h):
+                self._rehash(self.capacity * 2)
+                continue
+            self.table = table
+            self.set_array(f"{name}.__set__", new_present)
+            if new_last is not None:
+                self.set_array(f"{name}.__ts__", new_last)
+            self.mark_dirty(slots)
+            self._num_keys = int(occ_h)
+            if self._num_keys > 0.6 * self.capacity:
+                self._rehash(self.capacity * 2)
+            return np.asarray(fresh_h)
+
+    # ------------------------------------------------------------------
     # row-access compatibility plane (slow; host roundtrip per call)
     # ------------------------------------------------------------------
     def get_partitioned_state(self, descriptor: StateDescriptor) -> State:
         if descriptor.kind != "value":
             raise NotImplementedError(
                 "TPU backend row plane supports ValueState only; use array "
-                "states (device operators) or the hashmap backend")
+                "states (device operators), the device list plane "
+                "(state/device_lists.py), or the hashmap backend")
         handle = self._row_states.get(descriptor.name)
         if handle is None:
-            self.register_array_state(descriptor.name, "sum", jnp.float32)
-            self.register_array_state(f"{descriptor.name}.__set__", "sum",
-                                      jnp.int32)
+            default = descriptor.default
+            dtype = (np.asarray(default).dtype
+                     if default is not None
+                     and np.asarray(default).dtype.kind in "iuf"
+                     else np.float64)
+            ttl_ms = (int(descriptor.ttl.ttl * 1000)
+                      if descriptor.ttl is not None else None)
+            self.register_row_state(descriptor.name, dtype, ttl_ms)
             handle = _TpuValueState(self, descriptor)
             self._row_states[descriptor.name] = handle
         return handle
@@ -706,50 +895,31 @@ class TpuKeyedStateBackend(KeyedStateBackend):
 
 
 class _TpuValueState(ValueState):
-    """Row plane: one float32 cell per key plus a presence bit, so a stored
-    0.0 is distinguishable from 'never written' (API completeness; each call
-    is a host round-trip — the hot path is the array plane)."""
+    """Row plane per-key API handle over the typed batched plane below
+    (API completeness; each call is a host round-trip — batched access via
+    ``rows_lookup``/``rows_upsert`` and the array plane are the hot
+    paths)."""
 
     def __init__(self, backend: TpuKeyedStateBackend, desc: StateDescriptor):
         self._b, self._d = backend, desc
 
-    def _read_slot(self) -> int:
-        """Lookup WITHOUT insert: reading an absent key must not occupy a
-        table slot (it would leak into snapshots and occupancy)."""
-        key = jnp.asarray(
-            _sanitize_keys(np.asarray([self._b._current_key])))
-        return int(jax.device_get(lookup(self._b.table, key))[0])
-
-    def _write_slot(self) -> int:
-        key = np.asarray([self._b._current_key], dtype=np.int64)
-        return int(jax.device_get(self._b.slots_for_batch(key))[0])
-
     def value(self):
-        slot = self._read_slot()
-        if slot < 0:
+        key = np.asarray([self._b._current_key], np.int64)
+        vals, present = self._b.rows_lookup(
+            self._d.name, key, now_ms=int(time.time() * 1000))
+        if not present[0]:
             return self._d.default
-        present = int(jax.device_get(
-            self._b.get_array(f"{self._d.name}.__set__")[slot]))
-        if not present:
-            return self._d.default
-        return float(jax.device_get(self._b.get_array(self._d.name)[slot]))
+        v = vals[0]
+        return v.item() if isinstance(v, np.generic) else v
 
     def update(self, value) -> None:
-        slot = self._write_slot()
-        arr = self._b.get_array(self._d.name)
-        self._b.set_array(self._d.name, arr.at[slot].set(float(value)))
-        flag = self._b.get_array(f"{self._d.name}.__set__")
-        self._b.set_array(f"{self._d.name}.__set__", flag.at[slot].set(1))
+        key = np.asarray([self._b._current_key], np.int64)
+        self._b.rows_upsert(self._d.name, key, np.asarray([value]),
+                            now_ms=int(time.time() * 1000))
 
     def clear(self) -> None:
-        slot = self._read_slot()
-        if slot < 0:
-            return
-        self._b.mark_dirty(np.array([slot]))
-        arr = self._b.get_array(self._d.name)
-        self._b.set_array(self._d.name, arr.at[slot].set(0.0))
-        flag = self._b.get_array(f"{self._d.name}.__set__")
-        self._b.set_array(f"{self._d.name}.__set__", flag.at[slot].set(0))
+        key = np.asarray([self._b._current_key], np.int64)
+        self._b.rows_clear(self._d.name, key)
 
 
 register_backend("tpu", TpuKeyedStateBackend)
