@@ -414,6 +414,54 @@ def bench_host_q7() -> float:
     return HOST_EVENTS / dt
 
 
+def bench_tpch_q1(n_rows: int = 1 << 22) -> float:
+    """BASELINE config #5: TPC-H Q1 streaming GROUP BY through the SQL
+    layer (two-phase local/global split: the exchange carries one partial
+    row per distinct (returnflag, linestatus) per micro-batch —
+    StreamExecLocalGroupAggregate shape)."""
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core.config import PipelineOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.sql import TableEnvironment
+
+    schema = Schema([("l_returnflag", np.int64), ("l_linestatus", np.int64),
+                     ("l_quantity", np.float64),
+                     ("l_extendedprice", np.float64),
+                     ("l_discount", np.float64), ("l_tax", np.float64),
+                     ("l_shipdate", np.int64)])
+
+    def gen(idx):
+        u = idx.astype(np.uint64) * np.uint64(MULT)
+        return {"l_returnflag": (u % np.uint64(3)).astype(np.int64),
+                "l_linestatus": ((u >> np.uint64(8)) % np.uint64(2)).astype(
+                    np.int64),
+                "l_quantity": ((idx % 50) + 1).astype(np.float64),
+                "l_extendedprice": ((idx % 9973) + 1).astype(np.float64),
+                "l_discount": (idx % 11).astype(np.float64) / 100.0,
+                "l_tax": (idx % 9).astype(np.float64) / 100.0,
+                "l_shipdate": 19980101 + (idx % 1400)}
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.config.set(PipelineOptions.BATCH_SIZE, BATCH)
+    t_env = TableEnvironment(env)
+    ds = env.datagen(gen, schema, count=n_rows)
+    t_env.create_temporary_view("lineitem", ds, schema)
+    t0 = time.perf_counter()
+    res = t_env.execute_sql(
+        "SELECT l_returnflag, l_linestatus, SUM(l_quantity) sq, "
+        "SUM(l_extendedprice) sp, "
+        "SUM(l_extendedprice * (1 - l_discount)) sd, "
+        "SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) sc, "
+        "AVG(l_quantity) aq, AVG(l_extendedprice) ap, AVG(l_discount) ad, "
+        "COUNT(*) co FROM lineitem WHERE l_shipdate <= 19980902 "
+        "GROUP BY l_returnflag, l_linestatus")
+    final = res.collect_final()
+    wall = time.perf_counter() - t0
+    if len(final) != 6:
+        raise RuntimeError(f"tpch q1 produced {len(final)} groups")
+    return n_rows / wall
+
+
 def bench_tunnel() -> dict:
     """Transfer/dispatch diagnostics for the chip (which may sit behind a
     shared network tunnel): distinguishes framework regressions from link
@@ -509,6 +557,9 @@ def suite() -> None:
     join_eps = bench_framework_q7_join()
     _line("nexmark_q7_interval_join_events_per_sec", join_eps,
           "events/sec", join_eps / q7_host)
+
+    q1_eps = bench_tpch_q1()
+    _line("tpch_q1_streaming_rows_per_sec", q1_eps, "rows/sec", 1.0)
 
     kernel = bench_device()
     _line("q5_kernel_ceiling_events_per_sec_1M_keys", kernel,

@@ -50,6 +50,7 @@ class DeduplicateOperator(OneInputOperator):
         self._out_schema: Optional[Schema] = None
         self._backend = None          # device plane (tpu backend)
         self._device_checked = False
+        self._key_checked = False
 
     # -- device routing ----------------------------------------------------
     def _build_backend(self):
@@ -119,6 +120,18 @@ class DeduplicateOperator(OneInputOperator):
         retract = np.isin(kinds, (rk.UPDATE_BEFORE, rk.DELETE))
         backend = self._device_backend(batch.schema)
         if backend is not None:
+            if not self._key_checked:
+                # restored-eager path skipped the schema check: a key
+                # column that stopped being integer must fail loudly, not
+                # truncate
+                kf = batch.schema.fields[self.key_index]
+                if kf.dtype is object or not np.issubdtype(
+                        np.dtype(kf.dtype), np.integer):
+                    raise RuntimeError(
+                        "dedup device state restored but the key column "
+                        f"is {kf.dtype} (not integer); restore with the "
+                        "original schema or the hashmap backend")
+                self._key_checked = True
             # DEVICE keep-first: one fused admission program per batch
             keys = batch.column(names[self.key_index]).astype(np.int64)
             fresh = backend.dedup_first_batch(
@@ -204,7 +217,16 @@ class DeduplicateOperator(OneInputOperator):
                         {k: (0, v) for k, v in entries.items()})
         if device_snaps:
             # build + restore EAGERLY: a checkpoint taken before the first
-            # batch must re-emit this state, not an empty host plane
+            # batch must re-emit this state, not an empty host plane.
+            # Validate the config FIRST — a keep/backend change cannot
+            # silently reinterpret device keep-first state.
+            from ..core.config import StateOptions
+            if (self.keep != "first"
+                    or self.ctx.config.get(StateOptions.BACKEND) != "tpu"):
+                raise RuntimeError(
+                    "dedup state was checkpointed on the tpu backend but "
+                    "this run cannot use the device path (backend or keep "
+                    "changed); restore with the original config")
             self._restored_device = device_snaps
             self._backend = self._build_backend()
             self._device_checked = True

@@ -35,7 +35,7 @@ from ..core.records import RecordBatch, Schema, scalar as _scalar
 from ..runtime.operators.base import OneInputOperator, OperatorContext, Output
 from . import rowkind as rk
 
-__all__ = ["GroupAggOperator", "SqlAggSpec"]
+__all__ = ["GroupAggOperator", "LocalGroupAggOperator", "SqlAggSpec"]
 
 
 class SqlAggSpec:
@@ -62,10 +62,12 @@ class GroupAggOperator(OneInputOperator):
 
     def __init__(self, key_columns: Sequence[str], aggs: Sequence[SqlAggSpec],
                  count_star_index: Optional[int] = None,
+                 partial_input: bool = False,
                  name: str = "GroupAgg"):
         super().__init__(name)
         self._key_columns = list(key_columns)
         self._aggs = list(aggs)
+        self._partial_input = bool(partial_input)
         for a in self._aggs:
             if a.distinct:
                 raise NotImplementedError(
@@ -101,9 +103,10 @@ class GroupAggOperator(OneInputOperator):
         return out
 
     # -- data path ---------------------------------------------------------
-    def process_batch(self, batch: RecordBatch) -> None:
-        if batch.n == 0:
-            return
+    def _local_partials(self, batch: RecordBatch
+                        ) -> tuple[np.ndarray, list, np.ndarray]:
+        """The LOCAL phase: fold one batch into per-distinct-key partial
+        accumulator rows (uniq keys, key rows, partials [G, n_slots])."""
         keys, single_key = self._group_ids(batch)
         kinds = (batch.column(rk.ROWKIND_COLUMN).astype(np.int8)
                  if rk.ROWKIND_COLUMN in batch.schema
@@ -117,9 +120,7 @@ class GroupAggOperator(OneInputOperator):
         order = np.argsort(inverse, kind="stable")
         sorted_inv = inverse[order]
         starts = np.searchsorted(sorted_inv, np.arange(len(uniq)))
-        bounds = np.append(starts, batch.n)
 
-        # per-agg grouped partial reduction over the batch (local phase)
         partials = np.zeros((len(uniq), self._n_slots), np.float64)
         s = sign[order]
         partials[:, 0] = np.add.reduceat(s, starts)
@@ -137,6 +138,40 @@ class GroupAggOperator(OneInputOperator):
                 col = batch.column(a.field)[order].astype(np.float64)
                 red = np.minimum if a.kind == "min" else np.maximum
                 partials[:, off] = red.reduceat(col, starts)
+        return uniq, key_rows, partials
+
+    def _combine_partials(self, batch: RecordBatch
+                          ) -> tuple[np.ndarray, list, np.ndarray]:
+        """Partial-input mode (downstream of LocalGroupAggOperator): the
+        batch's rows ARE partial accumulator rows; combine per distinct
+        key (sum for additive slots, min/max-combine for extrema)."""
+        keys, single_key = self._group_ids(batch)
+        uniq, inverse = _unique_inverse(keys)
+        key_rows = [(k,) if single_key else k for k in uniq]
+        order = np.argsort(inverse, kind="stable")
+        starts = np.searchsorted(inverse[order], np.arange(len(uniq)))
+        partials = np.zeros((len(uniq), self._n_slots), np.float64)
+        pc = batch.column(_PARTIAL_COUNT)[order].astype(np.float64)
+        partials[:, 0] = np.add.reduceat(pc, starts)
+        for a, off in zip(self._aggs, self._offsets):
+            for j in range(_SLOTS[a.kind]):
+                col = batch.column(_partial_col(a.out_name, j))[order] \
+                    .astype(np.float64)
+                if a.kind == "min" and j == 0:
+                    partials[:, off] = np.minimum.reduceat(col, starts)
+                elif a.kind == "max" and j == 0:
+                    partials[:, off] = np.maximum.reduceat(col, starts)
+                else:
+                    partials[:, off + j] = np.add.reduceat(col, starts)
+        return uniq, key_rows, partials
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        if self._partial_input:
+            uniq, key_rows, partials = self._combine_partials(batch)
+        else:
+            uniq, key_rows, partials = self._local_partials(batch)
 
         # global phase: one state merge per distinct key + changelog emit
         out_rows: list[tuple] = []
@@ -231,6 +266,69 @@ class GroupAggOperator(OneInputOperator):
                 if kg in self.ctx.key_group_range:
                     self._state.setdefault(kg, {}).update(entries)
 
+
+
+_PARTIAL_COUNT = "__pc__"
+
+
+def _partial_col(out_name: str, j: int) -> str:
+    return f"{out_name}.__p{j}__"
+
+
+class LocalGroupAggOperator(OneInputOperator):
+    """The LOCAL half of two-phase GROUP BY (reference
+    StreamExecLocalGroupAggregate / MiniBatchLocalGroupAggFunction): runs
+    BEFORE the keyed exchange on every upstream subtask, folding each
+    micro-batch into one partial-accumulator row per distinct key, so the
+    exchange ships O(distinct keys) rows instead of O(records). Stateless
+    (nothing to checkpoint); the global GroupAggOperator(partial_input=
+    True) downstream combines partials and owns the changelog."""
+
+    def __init__(self, key_columns: Sequence[str], aggs: Sequence[SqlAggSpec],
+                 name: str = "LocalGroupAgg"):
+        super().__init__(name)
+        # reuse the partial computation via a throwaway global op core
+        self._core = GroupAggOperator(key_columns, aggs, name=name)
+        self._key_columns = list(key_columns)
+        self._aggs = list(aggs)
+        self._out_schema: Optional[Schema] = None
+
+    def setup(self, ctx: OperatorContext, output: Output) -> None:
+        super().setup(ctx, output)
+        self._core.ctx = ctx
+
+    def _schema_for(self, in_schema: Schema) -> Schema:
+        if self._out_schema is None:
+            fields = [(n, in_schema.field(n).dtype)
+                      for n in self._key_columns]
+            fields.append((_PARTIAL_COUNT, np.float64))
+            for a in self._aggs:
+                for j in range(_SLOTS[a.kind]):
+                    fields.append((_partial_col(a.out_name, j), np.float64))
+            self._out_schema = Schema(fields)
+        return self._out_schema
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        schema = self._schema_for(batch.schema)
+        _uniq, key_rows, partials = self._core._local_partials(batch)
+        g = len(key_rows)
+        cols: dict[str, np.ndarray] = {}
+        for i, n in enumerate(self._key_columns):
+            dtype = schema.field(n).dtype
+            if dtype is object:
+                arr = np.empty(g, object)
+                arr[:] = [kr[i] for kr in key_rows]
+            else:
+                arr = np.asarray([kr[i] for kr in key_rows], dtype=dtype)
+            cols[n] = arr
+        cols[_PARTIAL_COUNT] = partials[:, 0]
+        for a, off in zip(self._aggs, self._core._offsets):
+            for j in range(_SLOTS[a.kind]):
+                cols[_partial_col(a.out_name, j)] = partials[:, off + j]
+        ts = np.full(g, int(batch.timestamps.max()), np.int64)
+        self.output.emit(RecordBatch(schema, cols, ts))
 
 
 def _unique_inverse(keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

@@ -242,7 +242,7 @@ class IntervalJoinOperator(TwoInputOperator):
         # DeviceListStore — each side's buffered rows live in HBM and a
         # probe batch is ONE lookup+gather; see state/device_lists.py
         self._stores: list = [None, None]
-        self._schemas: list = [None, None]
+        self._side_ok = [False, False]   # per-side schema validated
         self._device: Optional[bool] = None
         self._restored_device: dict = {}
 
@@ -262,8 +262,8 @@ class IntervalJoinOperator(TwoInputOperator):
     def _device_eligible(self, schema: Schema, side: int) -> bool:
         if self._device is False:
             return False
-        if self._device and self._stores[side] is not None:
-            return True   # established; skip the per-batch scan
+        if self._device and self._side_ok[side]:
+            return True   # established AND validated; skip the scan
         from ..core.config import StateOptions
         if self.ctx.config.get(StateOptions.BACKEND) != "tpu":
             self._device = False
@@ -288,23 +288,17 @@ class IntervalJoinOperator(TwoInputOperator):
             self._device = False
             return False
         self._device = True
+        self._side_ok[side] = True
         return True
 
     def _store(self, side: int, schema: Schema):
+        # restored stores were materialized eagerly in initialize_state
         if self._stores[side] is None:
             from ..state.device_lists import DeviceListStore
-            self._schemas[side] = schema
-            snaps = self._restored_device.pop(side, None)
-            if snaps is not None:
-                # from_snapshots widens to the snapshot's row budget
-                self._stores[side] = DeviceListStore.from_snapshots(
-                    self.ctx.key_group_range, self.ctx.max_parallelism,
-                    snaps, rows_per_key=self.rows_per_key)
-            else:
-                self._stores[side] = DeviceListStore(
-                    self.ctx.key_group_range, self.ctx.max_parallelism,
-                    [np.dtype(f.dtype) for f in schema.fields],
-                    rows_per_key=self.rows_per_key)
+            self._stores[side] = DeviceListStore(
+                self.ctx.key_group_range, self.ctx.max_parallelism,
+                [np.dtype(f.dtype) for f in schema.fields],
+                rows_per_key=self.rows_per_key)
         return self._stores[side]
 
     def _process(self, side: int, batch: RecordBatch) -> None:
@@ -335,19 +329,6 @@ class IntervalJoinOperator(TwoInputOperator):
             self.output.emit(RecordBatch.from_rows(
                 self.out_schema, out_rows, out_ts))
 
-    def _other_store(self, side: int):
-        """The OTHER side's store — materialized from a restored snapshot
-        if that side hasn't seen a live batch yet."""
-        other = self._stores[1 - side]
-        if other is None and (1 - side) in self._restored_device:
-            from ..state.device_lists import DeviceListStore
-            other = DeviceListStore.from_snapshots(
-                self.ctx.key_group_range, self.ctx.max_parallelism,
-                self._restored_device.pop(1 - side),
-                rows_per_key=self.rows_per_key)
-            self._stores[1 - side] = other
-        return other
-
     def _process_device(self, side: int, batch: RecordBatch) -> None:
         """Batched probe of the other side's HBM lists + append of this
         batch — two device programs and one transfer per batch, replacing
@@ -355,7 +336,7 @@ class IntervalJoinOperator(TwoInputOperator):
         names = [f.name for f in batch.schema.fields]
         keys = batch.column(names[self.key_idx[side]]).astype(np.int64)
         ts = batch.timestamps
-        other = self._other_store(side)
+        other = self._stores[1 - side]
         if other is not None:
             packed, counts = other.probe_batch(keys)       # [B, L, C], [B]
             L = packed.shape[1]

@@ -421,7 +421,16 @@ class _Planner:
 
     def _group_agg(self, ds: DataStream, pre_schema: Schema,
                    key_names: list[str], agg_specs: list[SqlAggSpec]):
-        if not key_names:
+        from ..core.config import SqlOptions
+
+        # two-phase split (reference StreamExecLocalGroupAggregate /
+        # StreamExecGlobalGroupAggregate): a stateless local combine runs
+        # BEFORE the keyed exchange on each upstream subtask, so the
+        # exchange carries one partial row per distinct key per
+        # micro-batch; the global operator merges partials into state
+        two_phase = self.env.config.get(SqlOptions.TWO_PHASE_AGG)
+        is_global = not key_names
+        if is_global:
             # global aggregation: single pseudo key
             key_names = ["__global__"]
 
@@ -435,18 +444,28 @@ class _Planner:
 
             ds = ds.transform(
                 "GlobalKey", lambda: BatchFnOperator(add_global, "GlobalKey"))
+        specs = list(agg_specs)
+        names = list(key_names)
+        if two_phase:
+            from .group_agg import LocalGroupAggOperator
+            ds = ds.transform(
+                "LocalGroupAggregate",
+                lambda: LocalGroupAggOperator(names, specs))
+        if is_global:
             keyed = ds.key_by(lambda row: 0)
         elif len(key_names) == 1:
             keyed = ds.key_by(key_names[0])
         else:
-            key_idx = [pre_schema.index_of(n) for n in key_names]
+            # the local combine keeps key columns first in ITS output
+            key_idx = (tuple(range(len(key_names))) if two_phase
+                       else tuple(pre_schema.index_of(n)
+                                  for n in key_names))
             keyed = ds.key_by(
-                lambda row, _idx=tuple(key_idx): tuple(row[i] for i in _idx))
-        specs = list(agg_specs)
-        names = list(key_names)
+                lambda row, _idx=key_idx: tuple(row[i] for i in _idx))
         out = keyed._one_input(
             "GroupAggregate",
-            lambda: GroupAggOperator(names, specs),
+            lambda: GroupAggOperator(names, specs,
+                                     partial_input=two_phase),
             key_extractor=keyed.key_extractor)
         out_schema = Schema(
             [(n, np.float64 if n.startswith("__key") else object)

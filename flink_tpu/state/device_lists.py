@@ -76,9 +76,12 @@ def _probe_prog(table, rows, counts, keys):
 
 
 @jax.jit
-def _prune_prog(rows, counts, horizon, ts_col):
+def _prune_prog(table, rows, counts, horizon, ts_col):
     """Compact every key's list to rows with ts >= horizon (ts stored in
-    column ``ts_col`` of the packed block)."""
+    column ``ts_col`` of the packed block). Also reports occupancy and
+    how many occupied keys are now EMPTY — the caller compacts the hash
+    table when dead keys dominate (open addressing cannot delete in
+    place; the host twin deletes per watermark)."""
     cap, L, C = rows.shape
     live = jnp.arange(L)[None, :] < counts[:, None]         # [cap, L]
     keep = live & (rows[:, :, ts_col] >= horizon)
@@ -86,7 +89,9 @@ def _prune_prog(rows, counts, horizon, ts_col):
     perm = jnp.argsort(~keep, axis=1, stable=True)           # [cap, L]
     rows = jnp.take_along_axis(rows, perm[:, :, None], axis=1)
     counts = keep.sum(axis=1).astype(counts.dtype)
-    return rows, counts
+    occupied = table != jnp.int64(EMPTY_KEY)
+    dead = occupied & (counts == 0)
+    return rows, counts, occupied.sum(), dead.sum()
 
 
 class DeviceListStore:
@@ -173,9 +178,21 @@ class DeviceListStore:
 
     def prune(self, horizon: int) -> None:
         """Drop every row with ts < horizon (watermark cleanup) — one
-        device compaction, no transfer."""
-        self.rows, self.counts = _prune_prog(self.rows, self.counts,
-                                             np.int64(horizon), 0)
+        device compaction. When dead keys (occupied slots whose lists
+        emptied) dominate, the hash table is rebuilt without them so an
+        unbounded key domain cannot grow HBM without bound (the host
+        plane's per-watermark `del kmap[key]`)."""
+        self.rows, self.counts, occ, dead = _prune_prog(
+            self.table, self.rows, self.counts, np.int64(horizon), 0)
+        occ_h, dead_h = jax.device_get((occ, dead))
+        if int(dead_h) > 64 and int(dead_h) * 2 > int(occ_h):
+            t = np.asarray(jax.device_get(self.table))
+            counts = np.asarray(jax.device_get(self.counts))
+            alive = (t != np.int64(EMPTY_KEY)) & (counts > 0)
+            slots = np.flatnonzero(alive)
+            self._load(t[slots],
+                       np.asarray(jax.device_get(self.rows))[slots],
+                       counts[slots])
 
     def _rehash(self, new_capacity: int) -> None:
         t = np.asarray(jax.device_get(self.table))

@@ -683,6 +683,11 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         (+ last-update int64 plane when a TTL is set: entries expire
         ttl_ms after last update, checked lazily at read — the relaxed
         cleanup of the reference's StateTtlConfig)."""
+        if self._budget:
+            raise NotImplementedError(
+                "the typed row plane does not page to the host tier; "
+                "configure this backend without hbm_budget_slots (the "
+                "budget applies to the array/window plane)")
         if name in self._row_meta:
             return
         self._row_meta[name] = (int(ttl_ms or 0),
@@ -795,10 +800,14 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         handle = self._row_states.get(descriptor.name)
         if handle is None:
             default = descriptor.default
-            dtype = (np.asarray(default).dtype
-                     if default is not None
-                     and np.asarray(default).dtype.kind in "iuf"
-                     else np.float64)
+            # float64 unless the user EXPLICITLY typed the default with a
+            # numpy integer (a plain python-int default must not make
+            # later float updates truncate)
+            if isinstance(default, (np.integer, np.ndarray)) and \
+                    np.asarray(default).dtype.kind in "iu":
+                dtype = np.asarray(default).dtype
+            else:
+                dtype = np.float64
             ttl_ms = (int(descriptor.ttl.ttl * 1000)
                       if descriptor.ttl is not None else None)
             self.register_row_state(descriptor.name, dtype, ttl_ms)
