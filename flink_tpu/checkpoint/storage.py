@@ -259,6 +259,79 @@ class FsCheckpointStorage(CheckpointStorage):
         return {tid: walk(s)
                 for tid, s in checkpoint.task_snapshots.items()}
 
+    # -- versioned metadata encoding -----------------------------------
+    # The TypeSerializerSnapshot analog (flink-core api/common/typeutils/
+    # TypeSerializerSnapshot.java): checkpoint metadata is written as a
+    # VERSIONED, self-describing structure — framework classes are encoded
+    # as tagged plain dicts before pickling, so the on-disk format
+    # survives refactors of those classes (only plain containers, scalars,
+    # numpy arrays, and user payload types hit the pickle stream). The
+    # restore side rebuilds through a tag registry and still reads every
+    # older format (legacy class-pickle, uncompressed).
+
+    def _encode(self, obj):
+        if isinstance(obj, CompletedCheckpoint):
+            return {"__ftck__": "checkpoint",
+                    "checkpoint_id": obj.checkpoint_id,
+                    "timestamp": obj.timestamp,
+                    "task_snapshots": self._encode(obj.task_snapshots),
+                    "is_savepoint": obj.is_savepoint,
+                    "external_path": obj.external_path,
+                    "vertex_parallelism": dict(obj.vertex_parallelism),
+                    "vertex_uids": dict(obj.vertex_uids)}
+        if isinstance(obj, _PagedState):
+            return {"__ftck__": "paged",
+                    "pages": list(obj.pages),
+                    "dtype": getattr(obj, "dtype", None),
+                    "lead_shape": getattr(obj, "lead_shape", None)}
+        if isinstance(obj, _ChunkRef):
+            return {"__ftck__": "chunk", "hash": obj.hash,
+                    "dtype": obj.dtype, "shape": obj.shape}
+        if isinstance(obj, dict):
+            enc = {k: self._encode(v) for k, v in obj.items()}
+            if "__ftck__" in obj:
+                # keep the encoding injective: a user dict carrying the
+                # reserved tag key must not decode as a framework type
+                return {"__ftck__": "escaped", "value": enc}
+            return enc
+        if isinstance(obj, list):
+            return [self._encode(v) for v in obj]
+        if isinstance(obj, tuple):
+            return {"__ftck__": "tuple",
+                    "items": [self._encode(v) for v in obj]}
+        return obj
+
+    def _decode(self, obj):
+        if isinstance(obj, dict):
+            tag = obj.get("__ftck__")
+            if tag == "escaped":
+                # the wrapped dict's OWN top level is plain data — decode
+                # only its values, never its (user-owned) tag key
+                return {k: self._decode(v)
+                        for k, v in obj["value"].items()}
+            if tag == "checkpoint":
+                # keyword construction: field insertions/reorders in the
+                # dataclass must not misassign decoded values
+                return CompletedCheckpoint(
+                    checkpoint_id=obj["checkpoint_id"],
+                    timestamp=obj["timestamp"],
+                    task_snapshots=self._decode(obj["task_snapshots"]),
+                    is_savepoint=obj["is_savepoint"],
+                    external_path=obj["external_path"],
+                    vertex_parallelism=obj["vertex_parallelism"],
+                    vertex_uids=obj["vertex_uids"])
+            if tag == "paged":
+                return _PagedState(obj["pages"], obj["dtype"],
+                                   obj["lead_shape"])
+            if tag == "chunk":
+                return _ChunkRef(obj["hash"], obj["dtype"], obj["shape"])
+            if tag == "tuple":
+                return tuple(self._decode(v) for v in obj["items"])
+            return {k: self._decode(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [self._decode(v) for v in obj]
+        return obj
+
     # -- storage API ---------------------------------------------------
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
         d = self._path(checkpoint)
@@ -280,10 +353,10 @@ class FsCheckpointStorage(CheckpointStorage):
         # when built, zlib otherwise — self-describing tag either way
         from ..native import compress
         payload = compress(pickle.dumps(
-            to_write, protocol=pickle.HIGHEST_PROTOCOL))
+            self._encode(to_write), protocol=pickle.HIGHEST_PROTOCOL))
         tmp = os.path.join(d, "_metadata.part")
         with open(tmp, "wb") as f:
-            f.write(_COMPRESSED_MAGIC)
+            f.write(_VERSIONED_MAGIC)
             f.write(payload)
         final = os.path.join(d, "_metadata")
         os.replace(tmp, final)  # atomic publish
@@ -322,7 +395,12 @@ class FsCheckpointStorage(CheckpointStorage):
                                                                     "_metadata")
         with open(meta, "rb") as f:
             data = f.read()
-        if data.startswith(_COMPRESSED_MAGIC):
+        if data.startswith(_VERSIONED_MAGIC):
+            from ..native import decompress
+            cp = self._decode(pickle.loads(
+                decompress(data[len(_VERSIONED_MAGIC):])))
+        elif data.startswith(_COMPRESSED_MAGIC):
+            # format v1: compressed class-pickle
             from ..native import decompress
             cp = pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
         else:
@@ -337,4 +415,5 @@ class FsCheckpointStorage(CheckpointStorage):
         return cp
 
 
-_COMPRESSED_MAGIC = b"FTCK"
+_COMPRESSED_MAGIC = b"FTCK"   # format v1: compressed class-pickle (legacy)
+_VERSIONED_MAGIC = b"FTC2"    # format v2: compressed tagged-plain encoding

@@ -1,0 +1,116 @@
+"""Versioned checkpoint format (round 3, VERDICT r2 #9): metadata is a
+tagged plain-structure encoding under a format-version magic, so the
+on-disk format survives refactors of the framework's classes — the
+TypeSerializerSnapshot / StatefulJobSnapshotMigrationITCase analog. The
+committed fixture in tests/fixtures/checkpoint_v2 pins the format: if a
+change breaks reading it, that change needs a new format version and a
+legacy path, not a fixture update.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from flink_tpu.checkpoint.storage import (  # noqa: E402
+    _COMPRESSED_MAGIC, _VERSIONED_MAGIC, CompletedCheckpoint,
+    FsCheckpointStorage,
+)
+from flink_tpu.core import KeyGroupRange  # noqa: E402
+from flink_tpu.state.tpu_backend import TpuKeyedStateBackend  # noqa: E402
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "checkpoint_v2")
+
+
+class TestVersionedFormat:
+    def test_metadata_is_versioned_and_class_pickle_free(self, tmp_path):
+        """The stored metadata must not reference framework classes by
+        module path (that is what made format v1 fragile)."""
+        st = FsCheckpointStorage(str(tmp_path))
+        b = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128, capacity=256)
+        b.register_array_state("acc", "sum", np.float64)
+        keys = np.arange(50, dtype=np.int64)
+        slots = b.slots_for_batch(keys)
+        b.fold_batch("acc", slots, np.ones(50), slots >= 0)
+        cp = st.store(CompletedCheckpoint(
+            1, 0.0, {"t#0": {"keyed": b.snapshot(1)}}))
+        raw = open(os.path.join(cp.external_path, "_metadata"),
+                   "rb").read()
+        assert raw.startswith(_VERSIONED_MAGIC)
+        from flink_tpu.native import decompress
+        blob = decompress(raw[len(_VERSIONED_MAGIC):])
+        # no framework class paths inside the payload
+        assert b"flink_tpu.checkpoint" not in blob
+        assert b"CompletedCheckpoint" not in blob
+        assert b"_PagedState" not in blob
+
+    def test_roundtrip_preserves_everything(self, tmp_path):
+        st = FsCheckpointStorage(str(tmp_path))
+        snap = {"kind": "host", "rows": [(1, "a"), (2, "b")],
+                "nested": {"t": (3, 4.5)}}
+        cp = st.store(CompletedCheckpoint(
+            7, 123.0, {"x#0": {"keyed": snap}},
+            vertex_parallelism={"x": 2}, vertex_uids={"x": "u"}))
+        loaded = st.load(cp.external_path)
+        assert loaded.checkpoint_id == 7
+        assert loaded.vertex_parallelism == {"x": 2}
+        assert loaded.vertex_uids == {"x": "u"}
+        got = loaded.task_snapshots["x#0"]["keyed"]
+        assert got["rows"] == [(1, "a"), (2, "b")]
+        assert got["nested"]["t"] == (3, 4.5)
+
+    def test_reserved_tag_key_in_user_state_roundtrips(self, tmp_path):
+        st = FsCheckpointStorage(str(tmp_path))
+        tricky = {"__ftck__": "tuple", "items": [1, 2]}
+        cp = st.store(CompletedCheckpoint(
+            9, 0.0, {"t#0": {"keyed": {"user": tricky}}}))
+        loaded = st.load(cp.external_path)
+        assert loaded.task_snapshots["t#0"]["keyed"]["user"] == tricky
+
+    def test_legacy_v1_class_pickle_still_loads(self, tmp_path):
+        """Pre-upgrade checkpoints (FTCK compressed class-pickle) keep
+        loading."""
+        st = FsCheckpointStorage(str(tmp_path))
+        cp = CompletedCheckpoint(3, 0.0, {"t#0": {"keyed": {"n": 1}}})
+        d = os.path.join(str(tmp_path), "chk-3")
+        os.makedirs(d)
+        from flink_tpu.native import compress
+        with open(os.path.join(d, "_metadata"), "wb") as f:
+            f.write(_COMPRESSED_MAGIC)
+            f.write(compress(pickle.dumps(cp)))
+        loaded = st.load(d)
+        assert loaded.task_snapshots["t#0"]["keyed"] == {"n": 1}
+
+
+class TestCommittedFixtureMigration:
+    """Restore the checkpoint committed at a fixed point in history
+    (reference StatefulJobSnapshotMigrationITCase)."""
+
+    def test_fixture_restores_exactly(self):
+        st = FsCheckpointStorage(FIXTURE)
+        cp = st.load(os.path.join(FIXTURE, "chk-1"))
+        assert cp.checkpoint_id == 1
+        assert cp.vertex_uids == {"v1": "uid-source", "v2": "uid-agg"}
+        assert cp.vertex_parallelism == {"v1": 1, "v2": 1}
+        v1 = cp.task_snapshots["v1#0"]
+        assert v1["reader"] == 4242
+        meta = v1["chain"]["op"]["keyed"]["meta"]
+        assert meta == {"fired_boundary": 3, "min_seen_pane": 0,
+                        "max_seen_pane": 2, "watermark": 2999}
+        # device keyed state restores into a live backend with exact values
+        b = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128)
+        b.restore([v1["chain"]["op"]["keyed"]["backend"]])
+        from flink_tpu.ops.hash_table import EMPTY_KEY
+        t = np.asarray(jax.device_get(b.table))
+        occ = np.flatnonzero(t != np.int64(EMPTY_KEY))
+        acc = np.asarray(jax.device_get(b.get_array("acc")))
+        got = {int(t[s]): float(acc[int(t[s]) % 4, s]) for s in occ}
+        assert got == {k: float(k % 7) for k in range(200)}
+        # host-plane operator state (tuple keys, numpy values) intact
+        ga = cp.task_snapshots["v2#0"]["chain"]["sum"]["keyed"]["backend"]
+        entry = ga["group-agg"][5][(1, "x")]
+        np.testing.assert_array_equal(entry, np.array([2.0, 9.0]))
