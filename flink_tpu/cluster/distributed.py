@@ -41,6 +41,7 @@ from ..checkpoint.storage import CompletedCheckpoint, FsCheckpointStorage, \
     MemoryCheckpointStorage
 from ..core.config import CheckpointingOptions, Configuration, RuntimeOptions
 from .failover import restart_strategy_from_config
+from .resource_manager import SlotManager, build_schedule
 from ..graph.stream_graph import JobGraph
 from ..runtime.channels import InputGate, LocalChannel
 from ..runtime.operators.base import OperatorChain, OperatorContext
@@ -134,6 +135,10 @@ class _Coordinator:
         self.restarts = 0
         self._strategy = restart_strategy_from_config(config)
         self._expected: set[int] = set(range(n_hosts))
+        # slot registry + blocklist (reference ResourceManager/SlotManager +
+        # BlocklistHandler): registrations carry slot counts; a dead worker
+        # is blocklisted so a zombie re-registration never rejoins placement
+        self.resources = SlotManager()
         self._all_done_sent = False
         self._restart_inflight = False
         self._hb_timeout = 5.0  # refined when monitor() starts
@@ -177,6 +182,8 @@ class _Coordinator:
                             w.send_lock = prev.send_lock
                         self._workers[host_id] = w
                         self._all_done_sent = False
+                    self.resources.register_worker(host_id,
+                                                   msg.get("slots", 1))
                 elif kind == "heartbeat":
                     with self._lock:
                         w = self._workers.get(msg["host_id"])
@@ -318,7 +325,10 @@ class _Coordinator:
                         w.sock.close()
                     except OSError:
                         pass
-            live = sorted(self._workers)
+                self.resources.unregister_worker(d)
+                self.resources.blocklist.block(d, reason)
+            live = sorted(h for h in self._workers
+                          if not self.resources.blocklist.is_blocked(h))
             if not live:
                 self._restart_inflight = False
                 self.failed = f"{reason}; no surviving workers"
@@ -336,6 +346,7 @@ class _Coordinator:
             cp = self.completed[-1] if self.completed else None
             self._restart_inflight = False
         msg = {"type": "restart", "epoch": epoch, "live_hosts": live,
+               "slots": self.resources.slots_map(live),
                "reason": reason, "checkpoint_path": None, "checkpoint": None}
         if cp is not None:
             if cp.external_path:
@@ -425,7 +436,8 @@ class DistributedHost:
     # -- deployment --------------------------------------------------------
     def deploy(self, peer_data_addrs: dict[int, tuple[str, int]],
                live_hosts: Optional[list[int]] = None, epoch: int = 0,
-               restored: Optional[dict] = None) -> LocalJob:
+               restored: Optional[dict] = None,
+               slots: Optional[dict[int, int]] = None) -> LocalJob:
         """Instantiate ONLY this host's subtasks; wire cross-host edges
         through the transport (the Execution.deploy analog, but locality-
         filtered by the shared placement function). ``live_hosts`` narrows
@@ -433,14 +445,18 @@ class DistributedHost:
         subtasks move to survivors deterministically); ``epoch`` tags the
         transport streams so a restarted deployment never reads a previous
         attempt's in-flight data; ``restored`` maps task ids to checkpoint
-        snapshots."""
+        snapshots; ``slots`` weights placement by per-host slot capacity
+        (resource_manager.build_schedule — a 2-slot host takes twice the
+        subtasks of a 1-slot host)."""
         jg, config = self.jg, self.config
         job = LocalJob(jg, config)
         aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
         live = live_hosts or list(range(self.n_hosts))
+        schedule = (build_schedule({h: slots.get(h, 1) for h in live})
+                    if slots else list(live))
 
         def place(sub: int) -> int:
-            return live[sub % len(live)]
+            return schedule[sub % len(schedule)]
 
         def edge_key(ei: int, src_sub: int, dst_sub: int) -> str:
             return f"E{epoch}:e{ei}:{src_sub}:{dst_sub}"
@@ -557,6 +573,42 @@ class DistributedHost:
     def _uid_map(self) -> dict:
         return {vid: v.uid for vid, v in self.jg.vertices.items() if v.uid}
 
+    def _parsed_slot_counts(self) -> Optional[list[int]]:
+        """Strictly parse taskmanager.slots-per-host; one shared parser so
+        initial placement, registration, and restart placement can never
+        disagree about a host's capacity."""
+        raw = self.config.get(RuntimeOptions.SLOTS_PER_HOST)
+        if not raw:
+            return None
+        counts = []
+        for part in str(raw).split(","):
+            part = part.strip()
+            try:
+                n = int(part)
+            except ValueError:
+                raise ValueError(
+                    f"taskmanager.slots-per-host: bad entry {part!r} in "
+                    f"{raw!r} (want comma-separated non-negative ints)")
+            if n < 0:
+                raise ValueError(
+                    f"taskmanager.slots-per-host: negative slot count {n}")
+            counts.append(n)
+        return counts
+
+    def _config_slots(self, live: list[int]) -> dict[int, int]:
+        """SPMD-shared per-host slot map (identical config on every host =>
+        identical schedule): slots-per-host when set, else num-task-slots
+        uniformly — which under the interleaved schedule reproduces the
+        unweighted live[sub % len(live)] placement exactly."""
+        counts = self._parsed_slot_counts()
+        uniform = self.config.get(RuntimeOptions.NUM_TASK_SLOTS)
+        if counts is None:
+            return {h: uniform for h in live}
+        return {h: (counts[h] if h < len(counts) else uniform) for h in live}
+
+    def _my_slots(self) -> int:
+        return self._config_slots([self.host_id])[self.host_id]
+
     def _ctrl_send(self, msg: dict) -> None:
         with self._ctrl_lock:
             _send_msg(self._ctrl, msg)
@@ -591,7 +643,7 @@ class DistributedHost:
                     raise
                 time.sleep(0.1)
         self._ctrl_send({"type": "register", "host_id": self.host_id,
-                         "uids": self._uid_map()})
+                         "uids": self._uid_map(), "slots": self._my_slots()})
         threading.Thread(target=self._control_loop, name="worker-control",
                          daemon=True).start()
         threading.Thread(target=self._heartbeat_loop,
@@ -627,6 +679,18 @@ class DistributedHost:
                     return
                 if msg["type"] == "trigger_checkpoint":
                     cid = msg["checkpoint_id"]
+                    if (self.job is not None and not self._redeploying.is_set()
+                            and not self.job.tasks):
+                        # zero subtasks placed here (slot-weighted placement
+                        # can starve a host): ack with an empty snapshot so
+                        # the checkpoint never waits on us — this host is
+                        # "trivially done" but must not decline
+                        self._ctrl_send({"type": "ack",
+                                         "host_id": self.host_id,
+                                         "checkpoint_id": cid,
+                                         "savepoint": msg["savepoint"],
+                                         "snapshots": {}})
+                        continue
                     if (self._redeploying.is_set() or self.job is None
                             or self.job._done.is_set()):
                         # mid-failover or already finished: this attempt
@@ -725,6 +789,7 @@ class DistributedHost:
         restart_enabled = self.config.get(
             RuntimeOptions.RESTART_STRATEGY) != "none"
         live = sorted(peer_data_addrs)
+        slots = self._config_slots(live)
         epoch, restored = 0, None
         job = None
         try:
@@ -744,16 +809,18 @@ class DistributedHost:
                             if h in peer_data_addrs]
                     if self.host_id not in live:
                         break
+                    slots = intent.get("slots") or slots
                     restored = self._load_restore_map(intent)
                 job = self.deploy(peer_data_addrs, live_hosts=live,
-                                  epoch=epoch, restored=restored)
+                                  epoch=epoch, restored=restored, slots=slots)
                 job.checkpoint_listener = self._make_listener()
                 self._redeploying.clear()
                 if epoch > 0 and self._ctrl is not None:
                     # announce readiness for the new attempt
                     self._ctrl_send({"type": "register",
                                      "host_id": self.host_id,
-                                     "uids": self._uid_map()})
+                                     "uids": self._uid_map(),
+                                     "slots": self._my_slots()})
                 job.start()
                 try:
                     job.wait(remaining())

@@ -1,0 +1,452 @@
+"""High availability: leader election + HA metadata stores + HA supervision.
+
+Reference semantics (SURVEY §2.3): DefaultLeaderElectionService.java:50 with
+ZooKeeper/Kubernetes lease drivers, AbstractHaServices, JobGraphStore,
+JobResultStore (flink-runtime leaderelection/, highavailability/). A TPU
+deployment has no ZooKeeper; the coordination substrate is the shared
+filesystem the checkpoints already live on (GCS/NFS in production, a tmpdir
+in tests):
+
+* **Leadership** is a lease *directory* acquired with atomic ``os.mkdir``
+  (the one FS primitive that is create-exclusive everywhere), renewed by
+  rewriting a heartbeat file, and stolen after expiry by atomically renaming
+  the stale lease away — only one stealer's ``os.rename`` wins.
+* **Fencing**: every grant increments a monotonic epoch (the reference's
+  leader session id, ZooKeeperLeaderElectionDriver's znode czxid). Store
+  writes carry the writer's token and lose against a higher recorded token,
+  so a deposed leader's late write cannot clobber its successor's.
+* **HA stores** persist the job graph, the latest-completed-checkpoint
+  pointer, and the job result — everything a fresh leader needs to resume a
+  job after the previous master died (Dispatcher recovery path,
+  Dispatcher.java:514 + SessionDispatcherLeaderProcess).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import pickle
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+try:  # job graphs carry closure-based operator factories: cloudpickle
+    import cloudpickle as _graph_pickle  # serializes what pickle cannot
+except ImportError:  # pragma: no cover - cloudpickle ships in the image
+    _graph_pickle = pickle
+
+__all__ = ["LeaderElectionService", "FileHaServices", "HaJobSupervisor"]
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+@contextmanager
+def _flocked(lock_path: str):
+    """Serialize a read-check-write critical section across processes.
+    flock is the compare-and-swap stand-in for the file-based driver; a
+    production object-store driver would use generation-match CAS (GCS
+    if-generation-match / etcd txn) for the same sections."""
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+class _Lease:
+    """mkdir-based lease with steal-on-expiry and fencing epochs."""
+
+    def __init__(self, ha_dir: str, owner: str, lease_timeout: float):
+        self.dir = os.path.join(ha_dir, "leader.lock")
+        self.epoch_file = os.path.join(ha_dir, "leader.epoch")
+        self.flock_file = os.path.join(ha_dir, "leader.flock")
+        self.owner = owner
+        self.timeout = lease_timeout
+        self.token: int = -1
+        os.makedirs(ha_dir, exist_ok=True)
+
+    def _owner_file(self) -> str:
+        return os.path.join(self.dir, "owner")
+
+    def _bump_epoch(self) -> int:
+        # single writer: only the freshly-granted leader calls this
+        cur = 0
+        try:
+            with open(self.epoch_file) as f:
+                cur = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
+        _atomic_write(self.epoch_file, str(cur + 1).encode())
+        return cur + 1
+
+    def _read_owner(self) -> Optional[dict]:
+        try:
+            with open(self._owner_file()) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def try_acquire(self) -> bool:
+        """Acquire or steal; the whole check-steal-grant sequence runs under
+        the flock so a stale leader's concurrent renew cannot interleave
+        with a steal (every owner-file mutation shares the lock)."""
+        with _flocked(self.flock_file):
+            try:
+                os.mkdir(self.dir)
+            except FileExistsError:
+                holder = self._read_owner()
+                if (holder is not None
+                        and time.time() - holder["ts"] < self.timeout):
+                    return False
+                if holder is None:
+                    # just-created lease whose owner file hasn't landed yet:
+                    # grant the same grace window, keyed off the dir mtime
+                    try:
+                        age = time.time() - os.stat(self.dir).st_mtime
+                    except OSError:
+                        return False
+                    if age < self.timeout:
+                        return False
+                # expired: steal by renaming the stale lease away
+                tomb = f"{self.dir}.dead.{uuid.uuid4().hex[:8]}"
+                try:
+                    os.rename(self.dir, tomb)
+                except OSError:
+                    return False
+                try:
+                    for name in os.listdir(tomb):
+                        os.unlink(os.path.join(tomb, name))
+                    os.rmdir(tomb)
+                except OSError:
+                    pass
+                try:
+                    os.mkdir(self.dir)
+                except FileExistsError:
+                    return False
+            self.token = self._bump_epoch()
+            return self._write_owner()
+
+    def _write_owner(self) -> bool:
+        try:
+            _atomic_write(self._owner_file(),
+                          json.dumps({"owner": self.owner, "token": self.token,
+                                      "ts": time.time()}).encode())
+        except OSError:
+            return False
+        return True
+
+    def renew(self) -> bool:
+        """Heartbeat; returns False when leadership was lost (stolen).
+        Read-verify-write runs under the flock, so a renew can never land
+        inside a successor's freshly stolen lease; a missing owner file
+        means we were renamed away — treated as loss, never re-written."""
+        with _flocked(self.flock_file):
+            holder = self._read_owner()
+            if holder is None or holder["token"] != self.token:
+                return False
+            return self._write_owner()
+
+    def release(self) -> None:
+        with _flocked(self.flock_file):
+            holder = self._read_owner()
+            if holder is None or holder["token"] != self.token:
+                return
+            tomb = f"{self.dir}.dead.{uuid.uuid4().hex[:8]}"
+            try:
+                os.rename(self.dir, tomb)
+                for name in os.listdir(tomb):
+                    os.unlink(os.path.join(tomb, name))
+                os.rmdir(tomb)
+            except OSError:
+                pass
+
+    def current_token(self) -> int:
+        holder = self._read_owner()
+        return holder["token"] if holder else -1
+
+
+class LeaderElectionService:
+    """Contender loop with grant/revoke callbacks (reference
+    DefaultLeaderElectionService.java:50). ``start()`` spawns a daemon that
+    keeps contending; on grant it invokes ``on_grant(token)``, then renews
+    at timeout/3 cadence; a failed renewal (lease stolen after a stall)
+    invokes ``on_revoke()`` and goes back to contending."""
+
+    def __init__(self, ha_dir: str, owner: str, lease_timeout: float = 2.0,
+                 on_grant: Optional[Callable[[int], None]] = None,
+                 on_revoke: Optional[Callable[[], None]] = None):
+        self._lease = _Lease(ha_dir, owner, lease_timeout)
+        self.owner = owner
+        self.on_grant = on_grant
+        self.on_revoke = on_revoke
+        self._stop = threading.Event()
+        self._is_leader = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # test hook: while set, the leader stops renewing (simulates a GC
+        # pause / partitioned master) without stopping the service
+        self.suspend_renewal = threading.Event()
+
+    @property
+    def token(self) -> int:
+        return self._lease.token
+
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def wait_for_leadership(self, timeout: Optional[float] = None) -> bool:
+        return self._is_leader.wait(timeout)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"leader-elect-{self.owner}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        period = self._lease.timeout / 3
+        while not self._stop.is_set():
+            if not self._is_leader.is_set():
+                if self._lease.try_acquire():
+                    self._is_leader.set()
+                    if self.on_grant is not None:
+                        self.on_grant(self._lease.token)
+                else:
+                    self._stop.wait(period)
+                continue
+            self._stop.wait(period)
+            if self._stop.is_set():
+                break
+            if self.suspend_renewal.is_set():
+                continue
+            if not self._lease.renew():
+                self._is_leader.clear()
+                if self.on_revoke is not None:
+                    self.on_revoke()
+
+    def stop(self, release: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+        if self._is_leader.is_set():
+            self._is_leader.clear()
+            if release:
+                self._lease.release()
+
+
+class FileHaServices:
+    """HA metadata stores on a shared directory, with fenced writes
+    (reference AbstractHaServices: job graph store + checkpoint recovery
+    factory + JobResultStore)."""
+
+    def __init__(self, ha_dir: str):
+        self.dir = ha_dir
+        for sub in ("jobs", "checkpoints", "results"):
+            os.makedirs(os.path.join(ha_dir, sub), exist_ok=True)
+
+    # -- job graphs --------------------------------------------------------
+    def put_job_graph(self, job_id: str, job_graph: Any) -> None:
+        _atomic_write(os.path.join(self.dir, "jobs", f"{job_id}.pkl"),
+                      _graph_pickle.dumps(job_graph,
+                                          pickle.HIGHEST_PROTOCOL))
+
+    def get_job_graph(self, job_id: str) -> Optional[Any]:
+        try:
+            with open(os.path.join(self.dir, "jobs", f"{job_id}.pkl"),
+                      "rb") as f:
+                return pickle.loads(f.read())
+        except OSError:
+            return None
+
+    def list_jobs(self) -> list[str]:
+        return sorted(n[:-4] for n in os.listdir(os.path.join(self.dir, "jobs"))
+                      if n.endswith(".pkl"))
+
+    def remove_job(self, job_id: str) -> None:
+        for sub, name in (("jobs", f"{job_id}.pkl"),
+                          ("checkpoints", f"{job_id}.pkl")):
+            try:
+                os.unlink(os.path.join(self.dir, sub, name))
+            except OSError:
+                pass
+
+    # -- latest-checkpoint pointer (fenced) --------------------------------
+    def put_checkpoint(self, job_id: str, token: int, checkpoint: Any) -> bool:
+        """Record the latest completed checkpoint under fencing ``token``.
+        Returns False (write refused) when a higher token already wrote —
+        the caller has been deposed. Check+write is one flocked critical
+        section, so a deposed leader's in-flight write cannot land after
+        (and clobber) the successor's higher-token record."""
+        path = os.path.join(self.dir, "checkpoints", f"{job_id}.pkl")
+        with _flocked(path + ".lock"):
+            lease = self._lease_token()
+            if lease is not None and lease > token:
+                return False  # a successor leads, even if it hasn't written
+            existing = self._read(path)
+            if existing is not None and existing["token"] > token:
+                return False
+            _atomic_write(path, pickle.dumps(
+                {"token": token, "checkpoint": checkpoint},
+                pickle.HIGHEST_PROTOCOL))
+            return True
+
+    def get_checkpoint(self, job_id: str) -> Optional[Any]:
+        rec = self._read(os.path.join(self.dir, "checkpoints",
+                                      f"{job_id}.pkl"))
+        return rec["checkpoint"] if rec else None
+
+    # -- job results -------------------------------------------------------
+    def put_result(self, job_id: str, token: int, result: dict) -> bool:
+        path = os.path.join(self.dir, "results", f"{job_id}.pkl")
+        with _flocked(path + ".lock"):
+            lease = self._lease_token()
+            if lease is not None and lease > token:
+                return False
+            existing = self._read(path)
+            if existing is not None and existing["token"] > token:
+                return False
+            _atomic_write(path, pickle.dumps(
+                {"token": token, "result": result}, pickle.HIGHEST_PROTOCOL))
+            return True
+
+    def get_result(self, job_id: str) -> Optional[dict]:
+        rec = self._read(os.path.join(self.dir, "results", f"{job_id}.pkl"))
+        return rec["result"] if rec else None
+
+    def _lease_token(self) -> Optional[int]:
+        """The fencing token of the CURRENT lease holder (None when no
+        leader): fenced writes also lose against a successor that holds
+        the lease but hasn't written its first record yet."""
+        try:
+            with open(os.path.join(self.dir, "leader.lock", "owner")) as f:
+                return json.loads(f.read())["token"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    @staticmethod
+    def _read(path: str) -> Optional[dict]:
+        try:
+            with open(path, "rb") as f:
+                return pickle.loads(f.read())
+        except OSError:
+            return None
+
+
+class HaJobSupervisor:
+    """One master contender: waits for leadership, recovers the job from the
+    HA stores, supervises it (JobSupervisor underneath), and persists every
+    completed checkpoint so the NEXT leader resumes where this one died —
+    the Dispatcher/JobMaster failover loop
+    (SessionDispatcherLeaderProcess -> Dispatcher.submitJob recovery).
+
+    Run one instance per would-be master process; kill the leader and a
+    standby takes over from the last completed checkpoint."""
+
+    def __init__(self, ha: FileHaServices, job_id: str, config,
+                 owner: Optional[str] = None, lease_timeout: float = 2.0):
+        self.ha = ha
+        self.job_id = job_id
+        self.config = config
+        self.owner = owner or f"master-{uuid.uuid4().hex[:6]}"
+        self.election = LeaderElectionService(ha.dir, self.owner,
+                                              lease_timeout)
+        self.supervisor = None  # JobSupervisor while leading
+        self._killed = threading.Event()
+        self._fenced = threading.Event()  # a put_checkpoint was refused
+
+    def submit(self, job_graph: Any) -> None:
+        """Persist the job graph so any leader can recover it (reference
+        JobGraphStore.putJobGraph)."""
+        self.ha.put_job_graph(self.job_id, job_graph)
+
+    def kill(self) -> None:
+        """Simulate master death: stop renewing the lease and abandon the
+        running attempt WITHOUT releasing (a clean release would be a
+        graceful shutdown, not a failure)."""
+        self._killed.set()
+        self.election.stop(release=False)
+        sup = self.supervisor
+        if sup is not None and sup.current_job is not None:
+            sup.current_job.cancel()
+
+    def run(self, timeout: float = 60.0) -> dict:
+        """Contend; when leading, recover + supervise to completion.
+        Returns the job result dict ({"status": "done", ...})."""
+        from .scheduler import JobSupervisor
+
+        self.election.start()
+        deadline = time.time() + timeout
+        try:
+            while not self._killed.is_set():
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"no leadership within {timeout}s")
+                if not self.election.wait_for_leadership(min(remaining, 0.5)):
+                    done = self.ha.get_result(self.job_id)
+                    if done is not None:
+                        return done  # someone else finished it
+                    continue
+                token = self.election.token
+                done = self.ha.get_result(self.job_id)
+                if done is not None:
+                    return done
+                jg = self.ha.get_job_graph(self.job_id)
+                if jg is None:
+                    raise RuntimeError(f"job {self.job_id} not in HA store")
+                restore = self.ha.get_checkpoint(self.job_id)
+                self.supervisor = JobSupervisor(jg, self.config)
+                orig_deploy = self.supervisor._deploy
+
+                def deploy_with_ha_hook(restore_cp, _orig=orig_deploy,
+                                        _token=token):
+                    job = _orig(restore_cp)
+                    coord = self.supervisor.coordinator
+                    orig_complete = coord._complete
+
+                    def complete_and_publish(p):
+                        orig_complete(p)
+                        if p.completed is not None:
+                            if not self.ha.put_checkpoint(
+                                    self.job_id, _token, p.completed):
+                                # fenced out: a new leader took over — the
+                                # cancelled attempt must NOT read as a
+                                # clean finish (flag checked after run())
+                                self._fenced.set()
+                                job.cancel()
+                    coord._complete = complete_and_publish
+                    return job
+
+                self.supervisor._deploy = deploy_with_ha_hook
+                try:
+                    job = self.supervisor.run(
+                        timeout=max(deadline - time.time(), 1.0),
+                        initial_restore=restore)
+                except (RuntimeError, TimeoutError):
+                    if self._killed.is_set() or not self.election.is_leader():
+                        continue  # deposed mid-run; standby path
+                    raise
+                if self._killed.is_set():
+                    break
+                if self._fenced.is_set() or not self.election.is_leader():
+                    # deposed mid-run: the attempt ended via fencing cancel,
+                    # not completion — rejoin the standbys, never publish
+                    # "done" for a job that still runs elsewhere
+                    self._fenced.clear()
+                    continue
+                result = {"status": "done", "owner": self.owner,
+                          "attempts": self.supervisor.attempt}
+                self.ha.put_result(self.job_id, token, result)
+                return result
+            raise RuntimeError(f"master {self.owner} was killed")
+        finally:
+            self.election.stop(release=not self._killed.is_set())

@@ -76,6 +76,11 @@ class LocalJob(TaskReporter):
 
     # -- control -----------------------------------------------------------
     def start(self) -> None:
+        if not self.tasks:
+            # a host can legitimately hold zero subtasks (slot-weighted
+            # placement, parallelism < host count): it is trivially done
+            self._done.set()
+            return
         for t in self.tasks.values():
             t.start()
 

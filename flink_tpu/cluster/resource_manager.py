@@ -1,0 +1,182 @@
+"""Resource manager: slot registry, declarative requirements, blocklist.
+
+Reference semantics (SURVEY §2.3): ResourceManager.java:119 brokers slot
+requests against registered TaskExecutors through the SlotManager
+(slotmanager/DeclarativeSlotManager.java:67 — jobs *declare* requirements,
+the manager matches them as workers come and go), and the blocklist
+(runtime/blocklist/BlocklistHandler.java) excludes misbehaving nodes from
+scheduling until a timeout passes.
+
+TPU-native shape: there is no per-subtask slot *object* to ship around — the
+SPMD deployment (cluster/distributed.py) needs one thing from resource
+management: a **deterministic schedule**, the host sequence that subtask
+``i`` maps onto. The SlotManager therefore resolves (live workers × slot
+counts × blocklist) into ``schedule()`` — host ``h`` appears ``slots[h]``
+times, round-robin interleaved — and placement is
+``schedule[sub % len(schedule)]`` everywhere. That keeps the reference's capacity semantics (a 2-slot worker
+takes twice the subtasks of a 1-slot worker; a blocked worker takes none)
+while staying a pure function every SPMD host can evaluate identically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["SlotManager", "Blocklist", "BlockedNode",
+           "InsufficientResourcesError", "build_schedule"]
+
+
+class InsufficientResourcesError(RuntimeError):
+    """Declared requirements exceed registered capacity (reference
+    NoResourceAvailableException)."""
+
+
+@dataclass
+class BlockedNode:
+    host_id: int
+    reason: str
+    until: float  # absolute deadline; float('inf') = permanent
+
+
+class Blocklist:
+    """Nodes excluded from scheduling (reference BlocklistHandler: block on
+    repeated failures, auto-expire after the timeout)."""
+
+    def __init__(self):
+        self._nodes: dict[int, BlockedNode] = {}
+        self._lock = threading.Lock()
+
+    def block(self, host_id: int, reason: str,
+              ttl: Optional[float] = None) -> None:
+        until = float("inf") if ttl is None else time.time() + ttl
+        with self._lock:
+            cur = self._nodes.get(host_id)
+            # extending an existing block keeps the later deadline
+            if cur is None or until > cur.until:
+                self._nodes[host_id] = BlockedNode(host_id, reason, until)
+
+    def unblock(self, host_id: int) -> None:
+        with self._lock:
+            self._nodes.pop(host_id, None)
+
+    def is_blocked(self, host_id: int) -> bool:
+        with self._lock:
+            node = self._nodes.get(host_id)
+            if node is None:
+                return False
+            if time.time() >= node.until:
+                del self._nodes[host_id]
+                return False
+            return True
+
+    def active(self) -> list[BlockedNode]:
+        now = time.time()
+        with self._lock:
+            expired = [h for h, n in self._nodes.items() if now >= n.until]
+            for h in expired:
+                del self._nodes[h]
+            return sorted(self._nodes.values(), key=lambda n: n.host_id)
+
+
+@dataclass
+class _Worker:
+    host_id: int
+    slots: int
+    registered_at: float = field(default_factory=time.time)
+
+
+def build_schedule(slots: dict[int, int]) -> list[int]:
+    """Deterministic host sequence: host ``h`` appears ``slots[h]`` times,
+    round-robin interleaved (one entry per host per pass, ascending id, while
+    capacity remains). Placement = schedule[sub % len(schedule)].
+
+    Interleaving keeps low subtask indices spread across hosts — with
+    uniform slot counts this reduces exactly to the unweighted
+    ``live[sub % len(live)]`` placement, and with skewed counts every host
+    still receives work before any host receives its second share."""
+    remaining = {h: s for h, s in slots.items() if s > 0}
+    if not remaining:
+        raise InsufficientResourcesError(
+            f"no host contributes a positive slot count: {slots}")
+    out: list[int] = []
+    while remaining:
+        for h in sorted(remaining):
+            out.append(h)
+            remaining[h] -= 1
+            if remaining[h] == 0:
+                del remaining[h]
+    return out
+
+
+class SlotManager:
+    """Registry of workers and their slot capacity + declared requirements
+    (reference DeclarativeSlotManager: requirements are a standing
+    declaration, fulfillment is re-evaluated as workers register/die)."""
+
+    def __init__(self, blocklist: Optional[Blocklist] = None):
+        self._workers: dict[int, _Worker] = {}
+        self._required = 0
+        self._lock = threading.Lock()
+        self.blocklist = blocklist or Blocklist()
+
+    # -- registry ----------------------------------------------------------
+    def register_worker(self, host_id: int, slots: int = 1) -> None:
+        with self._lock:
+            self._workers[host_id] = _Worker(host_id, slots)
+
+    def unregister_worker(self, host_id: int) -> None:
+        with self._lock:
+            self._workers.pop(host_id, None)
+
+    def workers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._workers)
+
+    # -- requirements ------------------------------------------------------
+    def declare_requirements(self, slots: int) -> None:
+        with self._lock:
+            self._required = slots
+
+    def free_slots(self) -> int:
+        return max(self.total_slots() - self._required, 0)
+
+    def total_slots(self) -> int:
+        with self._lock:
+            return sum(w.slots for w in self._workers.values()
+                       if not self.blocklist.is_blocked(w.host_id))
+
+    def fulfilled(self) -> bool:
+        return self.total_slots() >= self._required
+
+    # -- scheduling --------------------------------------------------------
+    def slots_map(self, live: Optional[list[int]] = None) -> dict[int, int]:
+        """Usable slot counts: registered, alive (in ``live`` when given),
+        not blocklisted."""
+        with self._lock:
+            out = {}
+            for h, w in self._workers.items():
+                if live is not None and h not in live:
+                    continue
+                if self.blocklist.is_blocked(h):
+                    continue
+                out[h] = w.slots
+            return out
+
+    def schedule(self, live: Optional[list[int]] = None,
+                 required: Optional[int] = None) -> list[int]:
+        """The deterministic placement sequence; raises when capacity can't
+        cover ``required`` (default: the standing declaration)."""
+        slots = self.slots_map(live)
+        need = self._required if required is None else required
+        total = sum(slots.values())
+        if total < need:
+            raise InsufficientResourcesError(
+                f"need {need} slots, have {total} "
+                f"(workers={sorted(slots)}, "
+                f"blocked={[n.host_id for n in self.blocklist.active()]})")
+        if total == 0:
+            raise InsufficientResourcesError("no usable workers")
+        return build_schedule(slots)
