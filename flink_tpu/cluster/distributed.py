@@ -99,6 +99,9 @@ class _WorkerState:
     # several coordinator threads and a large inline-checkpoint restart
     # payload must not interleave with control frames
     send_lock: threading.Lock = field(default_factory=threading.Lock)
+    # latest per-group watermark minima shipped with this worker's
+    # heartbeat (cross-host watermark alignment)
+    wm_minima: dict = field(default_factory=dict)
 
 
 class _Coordinator:
@@ -189,6 +192,7 @@ class _Coordinator:
                         w = self._workers.get(msg["host_id"])
                         if w:
                             w.last_heartbeat = time.time()
+                            w.wm_minima = msg.get("wm_minima", {})
                 elif kind == "ack":
                     self._on_ack(msg)
                 elif kind == "decline":
@@ -367,6 +371,19 @@ class _Coordinator:
         while not self._stop.is_set():
             time.sleep(heartbeat_timeout / 3)
             now = time.time()
+            # cross-host watermark alignment: combine live workers' group
+            # minima, broadcast the global view (reference SourceCoordinator
+            # announceCombinedWatermark over the OperatorCoordinator RPC)
+            combined: dict[str, int] = {}
+            with self._lock:
+                for w in self._workers.values():
+                    if w.finished:
+                        continue  # stale minima must not hold the group back
+                    for g, m in (w.wm_minima or {}).items():
+                        combined[g] = min(m, combined.get(g, m))
+            # broadcast even when empty: workers REPLACE their remote view,
+            # so a finished group's stale minimum stops constraining anyone
+            self.broadcast({"type": "wm_alignment", "minima": combined})
             with self._lock:
                 dead = [w.host_id for w in self._workers.values()
                         if not w.finished
@@ -721,6 +738,11 @@ class DistributedHost:
                     self._restart_event.set()
                     if self.job is not None:
                         self.job.cancel()
+                elif msg["type"] == "wm_alignment":
+                    job = self.job
+                    if job is not None and not self._redeploying.is_set():
+                        job.watermark_alignment.set_remote_minima(
+                            msg["minima"])
                 elif msg["type"] == "all_done":
                     self._all_done.set()
                 elif msg["type"] == "cancel":
@@ -733,9 +755,13 @@ class DistributedHost:
     def _heartbeat_loop(self) -> None:
         interval = self.config.get(RuntimeOptions.HEARTBEAT_INTERVAL)
         while not self._cancelled.is_set():
+            job = self.job
+            minima = (job.watermark_alignment.local_minima()
+                      if job is not None else {})
             try:
                 self._ctrl_send({"type": "heartbeat",
-                                 "host_id": self.host_id})
+                                 "host_id": self.host_id,
+                                 "wm_minima": minima})
             except OSError:
                 return
             time.sleep(interval)

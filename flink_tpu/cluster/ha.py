@@ -234,6 +234,13 @@ class LeaderElectionService:
                 if self.on_revoke is not None:
                     self.on_revoke()
 
+    def step_down(self) -> None:
+        """Drop leadership immediately (e.g. the holder learned through a
+        fenced store write that a successor exists) without waiting for the
+        next failed renewal. The contender loop keeps running and may be
+        re-granted later with a fresh token."""
+        self._is_leader.clear()
+
     def stop(self, release: bool = True) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -273,10 +280,9 @@ class FileHaServices:
                       if n.endswith(".pkl"))
 
     def remove_job(self, job_id: str) -> None:
-        for sub, name in (("jobs", f"{job_id}.pkl"),
-                          ("checkpoints", f"{job_id}.pkl")):
+        for sub in ("jobs", "checkpoints", "results"):
             try:
-                os.unlink(os.path.join(self.dir, sub, name))
+                os.unlink(os.path.join(self.dir, sub, f"{job_id}.pkl"))
             except OSError:
                 pass
 
@@ -432,6 +438,13 @@ class HaJobSupervisor:
                         timeout=max(deadline - time.time(), 1.0),
                         initial_restore=restore)
                 except (RuntimeError, TimeoutError):
+                    if self._fenced.is_set():
+                        # a successor exists: drop leadership NOW — waiting
+                        # for the next failed renewal would let this loop
+                        # redeploy the job concurrently with the successor
+                        self._fenced.clear()
+                        self.election.step_down()
+                        continue
                     if self._killed.is_set() or not self.election.is_leader():
                         continue  # deposed mid-run; standby path
                     raise
@@ -439,9 +452,11 @@ class HaJobSupervisor:
                     break
                 if self._fenced.is_set() or not self.election.is_leader():
                     # deposed mid-run: the attempt ended via fencing cancel,
-                    # not completion — rejoin the standbys, never publish
-                    # "done" for a job that still runs elsewhere
+                    # not completion — drop leadership and rejoin the
+                    # standbys; never publish "done" for a job that still
+                    # runs elsewhere
                     self._fenced.clear()
+                    self.election.step_down()
                     continue
                 result = {"status": "done", "owner": self.owner,
                           "attempts": self.supervisor.attempt}

@@ -51,6 +51,8 @@ class LocalJob(TaskReporter):
         self.metrics_registry = None
         from ..state.queryable import KvStateRegistry
         self.kv_registry = KvStateRegistry()
+        from ..runtime.alignment import WatermarkAlignmentCoordinator
+        self.watermark_alignment = WatermarkAlignmentCoordinator()
 
     # -- TaskReporter ------------------------------------------------------
     def acknowledge_checkpoint(self, task_id: str, checkpoint_id: int,

@@ -1,0 +1,89 @@
+"""Watermark alignment across sources (reference SourceCoordinator.java:92
+announceCombinedWatermark + WatermarkAlignmentParams/WatermarkAlignmentEvent).
+
+Sources in the same alignment group must not run ahead of the group's
+slowest source by more than ``max_drift``: each source periodically reports
+its current watermark, the coordinator combines them into a group minimum,
+and a source whose watermark exceeds ``min + max_drift`` pauses reading
+until the group catches up. This caps cross-source event-time skew — the
+amount of out-of-order state (open windows, join buffers) a downstream
+keyed operator must hold, which on the TPU backend directly bounds the open
+pane span the accumulator ring must cover.
+
+In-process jobs share one coordinator per job. In SPMD distributed jobs each
+host aggregates its local sources and ships group minima with its heartbeat;
+the cluster coordinator combines them and broadcasts the global minima back
+(cluster/distributed.py), so alignment spans hosts exactly like the
+reference's operator-coordinator round trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+MAX_WATERMARK = (1 << 63) - 1
+
+__all__ = ["WatermarkAlignmentCoordinator", "MAX_WATERMARK"]
+
+
+class WatermarkAlignmentCoordinator:
+    """Tracks per-(group, source) watermarks; computes the max allowed
+    watermark per group. Idle/finished sources report MAX_WATERMARK which
+    excludes them from the minimum (reference WatermarksWithIdleness +
+    SourceCoordinator: idle subtasks don't hold the group back)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reported: dict[str, dict[str, int]] = {}   # group -> task -> wm
+        self._drift: dict[str, int] = {}                 # group -> max drift
+        # global minima pushed from the cluster coordinator (distributed
+        # mode); combined with local reports via min()
+        self._remote_min: dict[str, int] = {}
+
+    def report(self, group: str, task_id: str, watermark: int,
+               max_drift_ms: int) -> int:
+        """Record ``task_id``'s watermark; returns the group's current max
+        allowed watermark (min + drift)."""
+        with self._lock:
+            self._reported.setdefault(group, {})[task_id] = watermark
+            self._drift[group] = max_drift_ms
+            return self._max_allowed_locked(group)
+
+    def unregister(self, group: str, task_id: str) -> None:
+        """A finished source must not hold the group back forever."""
+        with self._lock:
+            self._reported.get(group, {}).pop(task_id, None)
+
+    def group_min(self, group: str) -> int:
+        """Minimum over this process's live reports (what a distributed
+        host ships with its heartbeat)."""
+        with self._lock:
+            wms = [w for w in self._reported.get(group, {}).values()]
+            return min(wms) if wms else MAX_WATERMARK
+
+    def local_minima(self) -> dict[str, int]:
+        with self._lock:
+            return {g: (min(t.values()) if t else MAX_WATERMARK)
+                    for g, t in self._reported.items()}
+
+    def set_remote_minima(self, minima: dict[str, int]) -> None:
+        """Install the cluster-combined minima (distributed broadcast).
+        Replaces the previous view: a group whose remote sources all
+        finished drops out and stops constraining local sources."""
+        with self._lock:
+            self._remote_min = dict(minima)
+
+    def max_allowed(self, group: str) -> int:
+        with self._lock:
+            return self._max_allowed_locked(group)
+
+    def _max_allowed_locked(self, group: str) -> int:
+        wms = list(self._reported.get(group, {}).values())
+        lo = min(wms) if wms else MAX_WATERMARK
+        remote = self._remote_min.get(group)
+        if remote is not None:
+            lo = min(lo, remote)
+        if lo >= MAX_WATERMARK:
+            return MAX_WATERMARK
+        return lo + self._drift.get(group, 0)

@@ -196,6 +196,22 @@ class SourceStreamTask(StreamTask):
         # wall-clock spent per stage of the source loop (observability /
         # bench breakdown): read = generator/IO, emit = chain + backpressure
         self.stage_s: dict[str, float] = {"read": 0.0, "emit": 0.0}
+        # watermark-alignment + admission-control observability
+        self.alignment_pauses = 0
+        self.alignment_max_overshoot_ms = 0
+        self.current_batch_size = 0
+        from collections import deque
+        self.batch_size_history: deque = deque(maxlen=1024)
+        # register in the alignment group at DEPLOY time with MIN, so no
+        # group-mate can run ahead during the start-up window before this
+        # source's first own report (all tasks are constructed before any
+        # is started)
+        align = getattr(reporter, "watermark_alignment", None)
+        if (align is not None and watermark_strategy is not None
+                and watermark_strategy.alignment_group):
+            align.report(watermark_strategy.alignment_group, task_id,
+                         MIN_TIMESTAMP,
+                         watermark_strategy.alignment_max_drift_ms)
 
     def restore_state(self, snapshot: Optional[dict]) -> None:
         if not snapshot:
@@ -233,11 +249,46 @@ class SourceStreamTask(StreamTask):
         last_data_time = time.time()
         idle = False
 
+        # watermark alignment (reference SourceCoordinator announceCombined-
+        # Watermark): sources in the strategy's group pause when ahead of
+        # group-min + drift; idle sources report MAX and don't hold it back
+        align = getattr(self.reporter, "watermark_alignment", None)
+        align_group = self.ws.alignment_group if align is not None else None
+        align_drift = self.ws.alignment_max_drift_ms
+        from .alignment import MAX_WATERMARK as _ALIGN_MAX
+
+        # admission control (reference BufferDebloater): batch size tracks
+        # throughput x target-latency so in-flight bytes stay bounded
+        adaptive = self.config.get(PipelineOptions.ADAPTIVE_BATCH)
+        if adaptive:
+            target_s = self.config.get(PipelineOptions.ADAPTIVE_TARGET_LATENCY)
+            min_batch = self.config.get(PipelineOptions.ADAPTIVE_MIN_BATCH)
+            max_batch = self.config.get(PipelineOptions.ADAPTIVE_MAX_BATCH)
+        self.current_batch_size = batch_size
+
         while not self._cancelled.is_set():
             self._drain_mailbox()
+            if align_group is not None:
+                cur = gen.current_watermark()
+                allowed = align.report(align_group, self.task_id,
+                                       _ALIGN_MAX if idle else cur,
+                                       align_drift)
+                if not idle and cur > allowed:
+                    self.alignment_pauses += 1
+                    if allowed - align_drift > MIN_TIMESTAMP:
+                        # overshoot is only meaningful once the group min
+                        # reflects a real report, not deploy-time MIN
+                        self.alignment_max_overshoot_ms = max(
+                            self.alignment_max_overshoot_ms, cur - allowed)
+                    time.sleep(0.001)  # paused: mailbox stays live above
+                    # pausing stops READING only — processing-time timers
+                    # in the chained operators must keep firing
+                    self._advance_processing_time(self.chain)
+                    continue
             t0 = time.perf_counter()
-            batch = self.reader.read_batch(batch_size)
-            self.stage_s["read"] += time.perf_counter() - t0
+            batch = self.reader.read_batch(self.current_batch_size)
+            read_dt = time.perf_counter() - t0
+            self.stage_s["read"] += read_dt
             if batch is None:  # exhausted (bounded)
                 break
             if batch.n:
@@ -254,7 +305,17 @@ class SourceStreamTask(StreamTask):
                     self.chain.process_batch(batch)
                 else:
                     out.emit(batch)
-                self.stage_s["emit"] += time.perf_counter() - t0
+                emit_dt = time.perf_counter() - t0
+                self.stage_s["emit"] += emit_dt
+                if adaptive:
+                    # desired = throughput x target; EMA toward it. At the
+                    # fixpoint one batch takes exactly target seconds.
+                    tput = batch.n / max(read_dt + emit_dt, 1e-9)
+                    desired = tput * target_s
+                    self.current_batch_size = int(min(max(
+                        0.5 * self.current_batch_size + 0.5 * desired,
+                        min_batch), max_batch))
+                    self.batch_size_history.append(self.current_batch_size)
             else:
                 time.sleep(0.001)  # unbounded source, nothing available
                 if (idle_timeout is not None and not idle
@@ -273,6 +334,9 @@ class SourceStreamTask(StreamTask):
                         out.emit_watermark(Watermark(wm))
             self._advance_processing_time(self.chain)
 
+        if align_group is not None:
+            # finished/cancelled source must not hold its group back
+            align.unregister(align_group, self.task_id)
         if not self._cancelled.is_set():
             self._drain_mailbox()
             # bounded source done: flush event time, finish chain, close edges
