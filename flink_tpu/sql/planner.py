@@ -383,6 +383,10 @@ class _Planner:
         seen = set()
         pre_fields = [(n, d) for n, d in pre_fields
                       if not (n in seen or seen.add(n))]
+        if not pre_fields:
+            # global COUNT(*) reads no input columns; a unit column keeps
+            # the batch's row count flowing through the exchange
+            pre_fields = [("__rows__", np.int8)]
         pre_schema = Schema(pre_fields)
 
         def pre_project(batch: RecordBatch) -> Optional[RecordBatch]:
@@ -402,7 +406,10 @@ class _Planner:
             for spec, fn in zip(agg_specs, agg_in_fns):
                 if fn is not None:
                     cols[spec.field] = np.asarray(fn(cols, n))
-            out_cols = {f.name: cols[f.name] for f in pre_schema.fields}
+            out_cols = {f.name: cols[f.name] for f in pre_schema.fields
+                        if f.name in cols}
+            if "__rows__" in pre_schema and "__rows__" not in out_cols:
+                out_cols["__rows__"] = np.zeros(n, np.int8)
             return RecordBatch(pre_schema, out_cols, ts)
 
         projected = ds.transform(

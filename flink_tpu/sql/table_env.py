@@ -16,8 +16,14 @@ import numpy as np
 
 from ..api.datastream import DataStream
 from ..api.environment import StreamExecutionEnvironment
+from ..core.config import Configuration
 from ..core.records import RecordBatch, Schema
 from . import rowkind as rk
+from .ddl import (
+    Catalog, CatalogTable, CreateTableStmt, CreateViewStmt, DescribeStmt,
+    DropStmt, InsertStmt, ShowTablesStmt, dtype_to_sql_type,
+    instantiate_sink, instantiate_source, parse_statement, sql_type_to_dtype,
+)
 from .parser import parse
 from .planner import PlanError, plan
 
@@ -90,6 +96,9 @@ class TableEnvironment:
     def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
         self.env = env or StreamExecutionEnvironment()
         self._catalog: dict[str, tuple[DataStream, Schema]] = {}
+        # DDL catalog: connector specs + views, re-plannable into a fresh
+        # execution environment per query (reference GenericInMemoryCatalog)
+        self.catalog = Catalog()
 
     @staticmethod
     def create(env: Optional[StreamExecutionEnvironment] = None
@@ -116,26 +125,203 @@ class TableEnvironment:
         return Table(self, stream, schema)
 
     def _resolve(self, name: str) -> tuple[DataStream, Schema]:
-        entry = self._catalog.get(name.lower())
-        if entry is None:
-            raise PlanError(f"table {name!r} not found; registered: "
-                            f"{sorted(self._catalog)}")
-        return entry
+        return self._make_resolver(self.env)(name)
+
+    def _make_resolver(self, env: StreamExecutionEnvironment):
+        """Name resolution for one query: bound streams as-is; catalog
+        specs instantiated into ``env`` (cached so a self-join shares one
+        source); views re-planned recursively."""
+        instantiated: dict[str, tuple[DataStream, Schema]] = {}
+
+        def resolve(name: str) -> tuple[DataStream, Schema]:
+            key = name.lower()
+            bound = self._catalog.get(key)
+            if bound is not None:
+                return bound
+            if key in instantiated:
+                return instantiated[key]
+            entry = self.catalog.get(key)
+            if entry is None:
+                raise PlanError(
+                    f"table {name!r} not found; registered: "
+                    f"{sorted(set(self._catalog) | set(self.catalog.names()))}")
+            if entry.kind == "stream":
+                out = (entry.stream, entry.schema)
+            elif entry.kind == "view":
+                stream = plan(entry.view_select, resolve, env)
+                out = (stream, stream._sql_schema)
+            else:
+                out = (instantiate_source(env, entry), entry.schema)
+            instantiated[key] = out
+            return out
+
+        return resolve
+
+    def _fresh_env(self) -> StreamExecutionEnvironment:
+        """Spec-backed queries get their own execution environment (same
+        config), so one TableEnvironment can run many statements without
+        re-executing earlier pipelines. Queries over bound user streams
+        must keep the user's env."""
+        if self._catalog or any(
+                t.kind == "stream" for n in self.catalog.names()
+                if (t := self.catalog.get(n))):
+            return self.env
+        return StreamExecutionEnvironment(
+            Configuration(dict(self.env.config._data)))
 
     # -- SQL ---------------------------------------------------------------
     def sql_query(self, sql: str) -> Table:
         stmt = parse(sql)
-        out = plan(stmt, self._resolve, self.env)
+        env = self._fresh_env()
+        out = plan(stmt, self._make_resolver(env), env)
         return Table(self, out, out._sql_schema)
 
     def execute_sql(self, sql: str,
                     timeout: Optional[float] = 120.0) -> TableResult:
-        return self.sql_query(sql).execute(timeout)
+        """Route one statement: queries plan+execute; DDL mutates the
+        catalog (reference TableEnvironmentImpl.executeSql:727)."""
+        stmt = parse_statement(sql)
+        if isinstance(stmt, CreateTableStmt):
+            schema = Schema([(c, sql_type_to_dtype(t))
+                             for c, t in stmt.columns])
+            self.catalog.create(
+                CatalogTable(stmt.name, "spec", schema, stmt.options,
+                             stmt.watermark_col, stmt.watermark_delay_ms),
+                if_not_exists=stmt.if_not_exists)
+            return self._ok()
+        if isinstance(stmt, CreateViewStmt):
+            self.catalog.create(
+                CatalogTable(stmt.name, "view", view_select=stmt.select))
+            return self._ok()
+        if isinstance(stmt, DropStmt):
+            # temporary views registered through create_temporary_view live
+            # in _catalog; SHOW/resolve and DROP must agree on both stores
+            if stmt.name.lower() in self._catalog:
+                del self._catalog[stmt.name.lower()]
+                return self._ok()
+            self.catalog.drop(stmt.name, stmt.kind, stmt.if_exists)
+            return self._ok()
+        if isinstance(stmt, ShowTablesStmt):
+            names = sorted(set(self.catalog.names())
+                           | set(self._catalog))
+            return TableResult(Schema([("table name", object)]),
+                               [(n,) for n in names])
+        if isinstance(stmt, DescribeStmt):
+            entry = self.catalog.get(stmt.name)
+            if entry is not None and entry.schema is not None:
+                schema = entry.schema
+            elif entry is not None and entry.kind == "view":
+                # derive the view's schema by planning it (no execution)
+                env = self._fresh_env()
+                schema = plan(entry.view_select,
+                              self._make_resolver(env), env)._sql_schema
+            elif stmt.name.lower() in self._catalog:
+                schema = self._catalog[stmt.name.lower()][1]
+            else:
+                raise PlanError(f"table {stmt.name!r} not found")
+            return TableResult(
+                Schema([("name", object), ("type", object)]),
+                [(f.name, dtype_to_sql_type(f.dtype))
+                 for f in schema.fields])
+        if isinstance(stmt, InsertStmt):
+            return self._execute_insert(stmt, timeout)
+        # plain query
+        env = self._fresh_env()
+        out = plan(stmt, self._make_resolver(env), env)
+        return Table(self, out, out._sql_schema).execute(timeout)
+
+    def _execute_insert(self, stmt: InsertStmt,
+                        timeout: Optional[float]) -> TableResult:
+        """INSERT INTO sink_table SELECT ... (reference executeInternal
+        with a ModifyOperation -> DynamicTableSink)."""
+        target = self.catalog.get(stmt.target)
+        if target is None:
+            raise PlanError(f"sink table {stmt.target!r} not found")
+        if target.kind != "spec":
+            raise PlanError(f"cannot INSERT INTO {target.kind} "
+                            f"{stmt.target!r}; target must be a connector-"
+                            f"backed table")
+        env = self._fresh_env()
+        stream = plan(stmt.select, self._make_resolver(env), env)
+        out_schema = stream._sql_schema
+        if len(out_schema) != len(target.schema):
+            raise PlanError(
+                f"INSERT INTO {stmt.target}: query produces "
+                f"{len(out_schema)} columns, table has "
+                f"{len(target.schema)}")
+        sink = instantiate_sink(target)
+        rows = _CountingSink()
+        stream.add_sink(rows.wrap(sink), f"insert-{stmt.target}")
+        stream.env.execute(f"insert-{stmt.target}", timeout=timeout)
+        return TableResult(Schema([("rows", np.int64)], ), [(rows.count,)])
 
     def _execute_table(self, table: Table,
                        timeout: Optional[float]) -> TableResult:
         from ..connectors.core import CollectSink
         sink = CollectSink()
         table.stream.add_sink(sink, "SqlCollect")
-        self.env.execute("sql-query", timeout=timeout)
+        # execute on the env the query was PLANNED into (a fresh one for
+        # spec-backed queries, the user's for bound streams)
+        table.stream.env.execute("sql-query", timeout=timeout)
         return TableResult(table.schema, sink.rows)
+
+    @staticmethod
+    def _ok() -> "TableResult":
+        return TableResult(Schema([("result", object)]), [("OK",)])
+
+
+class _CountingSink:
+    """Wraps the target sink so INSERT INTO can report rows written."""
+
+    def __init__(self):
+        self.count = 0
+        import threading
+        self._lock = threading.Lock()
+
+    def _add(self, n: int) -> None:
+        with self._lock:
+            self.count += n
+
+    def wrap(self, sink):
+        from ..connectors.core import Sink, SinkWriter
+        from ..core.functions import SinkFunction
+
+        outer = self
+        if isinstance(sink, Sink):
+            class _CountingWrapper(Sink):
+                def create_writer(self, subtask_index: int) -> SinkWriter:
+                    inner = sink.create_writer(subtask_index)
+
+                    class _W(SinkWriter):
+                        def write_batch(self, batch):
+                            outer._add(batch.n)
+                            return inner.write_batch(batch)
+
+                        def flush(self):
+                            inner.flush()
+
+                        def prepare_commit(self, checkpoint_id):
+                            inner.prepare_commit(checkpoint_id)
+
+                        def commit(self, checkpoint_id):
+                            inner.commit(checkpoint_id)
+
+                        def snapshot(self):
+                            return inner.snapshot()
+
+                        def restore(self, state):
+                            inner.restore(state)
+
+                        def close(self):
+                            inner.close()
+
+                    return _W()
+
+            return _CountingWrapper()
+
+        class _CountingFn(SinkFunction):
+            def invoke_batch(self, batch):
+                outer._add(batch.n)
+                return sink.invoke_batch(batch)
+
+        return _CountingFn()
