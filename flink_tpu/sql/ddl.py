@@ -173,7 +173,9 @@ class _DdlParser:
         if what == "VIEW":
             name = self.ident()
             self.expect_kw("AS")
-            rest = self.sql[self._rest_pos():]
+            rest = self.sql[self._rest_pos():].strip()
+            if not rest:
+                raise SqlError(f"CREATE VIEW {name}: missing SELECT body")
             return CreateViewStmt(name, parse(rest), rest, temporary)
         if_not_exists = False
         if self.accept_kw("IF"):
@@ -267,7 +269,9 @@ class _DdlParser:
         self.expect_kw("INSERT")
         self.expect_kw("INTO")
         target = self.ident()
-        rest = self.sql[self._rest_pos():]
+        rest = self.sql[self._rest_pos():].strip()
+        if not rest:
+            raise SqlError(f"INSERT INTO {target}: missing SELECT body")
         return InsertStmt(target, parse(rest))
 
 
@@ -301,13 +305,12 @@ class CatalogTable:
     DataStream)."""
 
     name: str
-    kind: str                      # "spec" | "view" | "stream"
+    kind: str                      # "spec" | "view"
     schema: Optional[Schema] = None
     options: dict = field(default_factory=dict)
     watermark_col: Optional[str] = None
     watermark_delay_ms: int = 0
     view_select: Optional[SelectStmt] = None
-    stream: Any = None             # bound DataStream for kind == "stream"
 
 
 class Catalog:
@@ -333,7 +336,7 @@ class Catalog:
                 return
             raise SqlError(f"{kind.lower()} {name!r} does not exist")
         is_view = entry.kind == "view"
-        if (kind == "VIEW") != is_view and entry.kind != "stream":
+        if (kind == "VIEW") != is_view:
             raise SqlError(f"{name!r} is a {'view' if is_view else 'table'}; "
                            f"use DROP {'VIEW' if is_view else 'TABLE'}")
         del self._tables[key]

@@ -145,9 +145,7 @@ class TableEnvironment:
                 raise PlanError(
                     f"table {name!r} not found; registered: "
                     f"{sorted(set(self._catalog) | set(self.catalog.names()))}")
-            if entry.kind == "stream":
-                out = (entry.stream, entry.schema)
-            elif entry.kind == "view":
+            if entry.kind == "view":
                 stream = plan(entry.view_select, resolve, env)
                 out = (stream, stream._sql_schema)
             else:
@@ -162,9 +160,7 @@ class TableEnvironment:
         config), so one TableEnvironment can run many statements without
         re-executing earlier pipelines. Queries over bound user streams
         must keep the user's env."""
-        if self._catalog or any(
-                t.kind == "stream" for n in self.catalog.names()
-                if (t := self.catalog.get(n))):
+        if self._catalog:
             return self.env
         return StreamExecutionEnvironment(
             Configuration(dict(self.env.config._data)))
@@ -244,11 +240,31 @@ class TableEnvironment:
         env = self._fresh_env()
         stream = plan(stmt.select, self._make_resolver(env), env)
         out_schema = stream._sql_schema
+        if rk.ROWKIND_COLUMN in out_schema:
+            raise PlanError(
+                f"INSERT INTO {stmt.target}: the query produces a "
+                "retracting changelog; only append-only queries can feed "
+                "a table sink (aggregate before inserting or collect the "
+                "result instead)")
         if len(out_schema) != len(target.schema):
             raise PlanError(
                 f"INSERT INTO {stmt.target}: query produces "
                 f"{len(out_schema)} columns, table has "
                 f"{len(target.schema)}")
+        # map query columns to the TARGET's names positionally (reference
+        # maps insert columns by position): formats like json encode field
+        # names, so aliased query outputs must be renamed before the sink
+        target_schema = target.schema
+        src_names = out_schema.names
+
+        def rename(batch: RecordBatch):
+            cols = {t: batch.columns[s]
+                    for s, t in zip(src_names, target_schema.names)}
+            return RecordBatch(target_schema, cols, batch.timestamps)
+
+        from ..runtime.operators.simple import BatchFnOperator
+        stream = stream.transform(
+            "InsertRename", lambda: BatchFnOperator(rename, "InsertRename"))
         sink = instantiate_sink(target)
         rows = _CountingSink()
         stream.add_sink(rows.wrap(sink), f"insert-{stmt.target}")

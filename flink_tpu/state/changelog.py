@@ -1,25 +1,21 @@
-"""Changelog state backend: O(delta) checkpoints via a state-change log.
+"""Changelog state backend: O(delta) checkpoints via a durable state-change
+log (DSTL).
 
 Analog of the reference's changelog backend + DSTL (flink-runtime
 state/changelog/ChangelogKeyedStateBackend.java:110, flink-dstl
 fs/FsStateChangelogStorage.java:57): every state mutation appends a change
-record to a log; a checkpoint ships only the log suffix since the last
-materialization plus a handle to the materialized base, so checkpoint cost
-is proportional to the change rate, not the state size. Periodically the
-wrapped backend materializes (full snapshot) and the log truncates.
+record to the log writer (state/dstl.py — buffered, batch-uploaded
+segments); a checkpoint ships only (base handle, segment handles past the
+base), so checkpoint bytes are proportional to the change rate, not the
+state size. Periodically the wrapped backend materializes: the full
+snapshot is written ONCE to the changelog store, subsequent checkpoints
+share it by handle, and segments covered by the base are deleted
+(truncation).
 
-Implementation notes vs the reference:
-* wraps the heap backend by overriding its _put/_remove choke points;
-  change values are serialized at write time (pickle) exactly like DSTL
-  serializes into the log — this also guards against later in-place
-  mutation of logged references;
-* the materialized base is shared BY REFERENCE across the checkpoints
-  between two materializations (in-memory storage stores it once; the
-  filesystem storage re-serializes it per checkpoint — true file-level
-  dedup of the base is future work, the semantic contract is the same);
-* restore = restore materialized base, then replay the log in order,
-  filtered to this backend's key-group range (rescaling works the same
-  way it does for full snapshots).
+Restore = load the materialized base by handle, then replay segments in
+sequence order, filtered to this backend's key-group range — rescaling
+works exactly as it does for full snapshots. Old-format inline snapshots
+("kind": "changelog") restore too.
 """
 
 from __future__ import annotations
@@ -31,6 +27,9 @@ from typing import Any, Iterable, Optional
 from ..core.keygroups import KeyGroupRange
 from .backend import register_backend
 from .descriptors import StateDescriptor
+from .dstl import (
+    ChangelogWriter, changelog_storage_for, read_any_base, read_any_segment,
+)
 from .heap import HeapKeyedStateBackend, _Entry
 
 __all__ = ["ChangelogKeyedStateBackend"]
@@ -39,7 +38,7 @@ __all__ = ["ChangelogKeyedStateBackend"]
 class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
     def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
                  config=None, materialization_interval: Optional[int] = None,
-                 **kwargs):
+                 flush_bytes: int = 1 << 20, **kwargs):
         super().__init__(key_group_range, max_parallelism, **kwargs)
         if materialization_interval is None:
             materialization_interval = 10
@@ -48,78 +47,148 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
                 materialization_interval = config.get(
                     StateOptions.CHANGELOG_MATERIALIZATION_INTERVAL)
         self._mat_interval = max(1, int(materialization_interval))
-        self._log: list[tuple] = []          # change records since mat
-        self._mat: Optional[dict] = None     # last materialized snapshot
+        self._store = changelog_storage_for(config)
+        self._writer = ChangelogWriter(self._store, flush_bytes=flush_bytes)
+        self._base_location: Optional[str] = None   # handle to live base
+        self._base_seq = 0                          # log seq covered by base
         self._mat_id = 0
         self._checkpoints_since_mat = 0
+        # retained checkpoints may reference superseded bases/segments:
+        # keep enough materialization GENERATIONS that the oldest retained
+        # checkpoint still restores (reference: artifact ownership +
+        # subsumption-driven cleanup; here derived from the retention
+        # config). Savepoints older than the kept window need the
+        # state-processor to rewrite them — documented limitation.
+        import math
+        retained = 1
+        if config is not None:
+            from ..core.config import CheckpointingOptions
+            retained = config.get(CheckpointingOptions.RETAINED)
+        self._keep_generations = max(1, math.ceil(
+            retained / self._mat_interval))
+        self._old_generations: list[tuple[str, list]] = []
 
     # -- logged mutations --------------------------------------------------
     def _put(self, desc: StateDescriptor, value: Any) -> None:
         super()._put(desc, value)
-        self._log.append((
-            "put", desc.name, self._current_key_group,
-            pickle.dumps((self._current_key, self._current_namespace, value),
-                         protocol=pickle.HIGHEST_PROTOCOL),
-            time.time() + desc.ttl.ttl if desc.ttl else None))
+        payload = pickle.dumps(
+            (self._current_key, self._current_namespace, value),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        self._writer.append(
+            ("put", desc.name, self._current_key_group, payload,
+             time.time() + desc.ttl.ttl if desc.ttl else None),
+            len(payload))
 
     def _remove(self, desc: StateDescriptor) -> None:
         super()._remove(desc)
-        self._log.append((
-            "rm", desc.name, self._current_key_group,
-            pickle.dumps((self._current_key, self._current_namespace),
-                         protocol=pickle.HIGHEST_PROTOCOL), None))
+        payload = pickle.dumps(
+            (self._current_key, self._current_namespace),
+            protocol=pickle.HIGHEST_PROTOCOL)
+        self._writer.append(
+            ("rm", desc.name, self._current_key_group, payload, None),
+            len(payload))
 
-    # -- checkpointing -----------------------------------------------------
+    # -- observability -----------------------------------------------------
     @property
     def log_size(self) -> int:
-        return len(self._log)
+        return self._writer.last_seq - self._base_seq
 
+    @property
+    def bytes_uploaded(self) -> int:
+        return self._writer.bytes_uploaded
+
+    # -- checkpointing -----------------------------------------------------
     def materialize(self, checkpoint_id: int) -> None:
-        """Full snapshot of the wrapped backend; truncates the log
-        (reference periodic materialization)."""
-        self._mat = super().snapshot(checkpoint_id)
+        """Full snapshot of the wrapped backend written ONCE to the
+        changelog store. The previous generation's base + covered segments
+        move to deferred deletion: they are deleted only once enough newer
+        generations exist that no retained checkpoint references them."""
+        import uuid
+
         self._mat_id += 1
-        self._log = []
+        base = super().snapshot(checkpoint_id)
+        prev_base = self._base_location
+        # id embeds the key-group range + a nonce: parallel subtasks share
+        # one store and must never collide on a base location
+        base_id = (f"kg{self.key_group_range.start}-"
+                   f"{self.key_group_range.end}-m{self._mat_id}-"
+                   f"c{checkpoint_id}-{uuid.uuid4().hex[:8]}")
+        self._base_location = self._store.write_base(
+            base_id, pickle.dumps(base, protocol=pickle.HIGHEST_PROTOCOL))
+        self._base_seq = self._writer.last_seq
+        covered = self._writer.detach(self._base_seq)
+        if prev_base is not None:
+            self._old_generations.append((prev_base, covered))
+        else:
+            # no checkpoint ever referenced pre-first-materialization
+            # segments (snapshot() materializes before returning handles)
+            for h in covered:
+                self._store.delete_segment(h)
+        while len(self._old_generations) > self._keep_generations:
+            loc, segments = self._old_generations.pop(0)
+            self._store.delete_base(loc)
+            for h in segments:
+                self._store.delete_segment(h)
         self._checkpoints_since_mat = 0
 
     def snapshot(self, checkpoint_id: int) -> dict:
-        if self._mat is None \
+        if self._base_location is None \
                 or self._checkpoints_since_mat >= self._mat_interval:
             self.materialize(checkpoint_id)
         self._checkpoints_since_mat += 1
-        return {"kind": "changelog", "mat_id": self._mat_id,
-                "mat": self._mat, "log": list(self._log)}
+        segments = self._writer.persist(self._base_seq)
+        return {"kind": "changelog-dstl",
+                "driver": self._store.driver,
+                "base": self._base_location,
+                "base_seq": self._base_seq,
+                "mat_id": self._mat_id,
+                "segments": [h.__dict__ for h in segments]}
 
     def restore(self, snapshots: Iterable[dict]) -> None:
-        mats, logs = [], []
-        plain = []
+        bases, replogs, plain = [], [], []
+        legacy_logs = []
         for snap in snapshots:
-            if snap.get("kind") == "changelog":
+            kind = snap.get("kind")
+            if kind == "changelog-dstl":
+                if snap.get("base") is not None:
+                    bases.append(pickle.loads(read_any_base(
+                        snap["driver"], snap["base"])))
+                records: list[tuple[int, Any]] = []
+                for h in snap.get("segments", []):
+                    records.extend(read_any_segment(h))
+                replogs.append((snap.get("base_seq", 0), records))
+            elif kind == "changelog":      # old inline format
                 if snap.get("mat") is not None:
-                    mats.append(snap["mat"])
-                logs.append(snap.get("log", []))
+                    bases.append(snap["mat"])
+                legacy_logs.append(snap.get("log", []))
             else:
-                plain.append(snap)  # switching from a non-changelog backend
-        super().restore(mats + plain)
-        for log in logs:
-            self._replay(log)
-        # restored state is the new base: materialize lazily on first
-        # snapshot (mat=None forces it)
-        self._mat = None
-        self._log = []
+                plain.append(snap)         # switching from another backend
+        super().restore(bases + plain)
+        for base_seq, records in replogs:
+            # segments may predate the base (flushed early): replay only
+            # records the base does not already cover, in seq order
+            for seq, rec in sorted(records):
+                if seq > base_seq:
+                    self._apply(rec)
+        for log in legacy_logs:
+            for rec in log:
+                self._apply(rec)
+        # restored state is the new base: materialize on first snapshot
+        self._base_location = None
+        self._base_seq = self._writer.last_seq
         self._checkpoints_since_mat = 0
 
-    def _replay(self, log: list) -> None:
-        for op, name, kg, payload, expiry in log:
-            if int(kg) not in self.key_group_range:
-                continue
-            table = self._table(name).setdefault(int(kg), {})
-            if op == "put":
-                key, ns, value = pickle.loads(payload)
-                table[(key, ns)] = _Entry(value, expiry)
-            else:
-                key, ns = pickle.loads(payload)
-                table.pop((key, ns), None)
+    def _apply(self, rec: tuple) -> None:
+        op, name, kg, payload, expiry = rec
+        if int(kg) not in self.key_group_range:
+            return
+        table = self._table(name).setdefault(int(kg), {})
+        if op == "put":
+            key, ns, value = pickle.loads(payload)
+            table[(key, ns)] = _Entry(value, expiry)
+        else:
+            key, ns = pickle.loads(payload)
+            table.pop((key, ns), None)
 
 
 register_backend("changelog", ChangelogKeyedStateBackend)

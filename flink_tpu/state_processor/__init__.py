@@ -50,7 +50,7 @@ def _iter_heap_states(keyed_snapshot: dict, state_name: str
                       ) -> Iterator[KeyedStateRecord]:
     """Iterate a heap/changelog-kind keyed snapshot's entries."""
     snap = keyed_snapshot.get("backend", keyed_snapshot)
-    if snap.get("kind") == "changelog":
+    if snap.get("kind") in ("changelog", "changelog-dstl"):
         # materialized base + replayed log = current view; reuse the
         # backend's own replay for fidelity
         from ..state.changelog import ChangelogKeyedStateBackend
@@ -98,11 +98,21 @@ class SavepointReader:
         for snap in self._op_snapshots(vertex, op_key):
             keyed = snap.get("keyed") or {}
             inner = keyed.get("backend", keyed)
-            if inner.get("kind") == "changelog":
+            if inner.get("kind") in ("changelog", "changelog-dstl"):
                 # states created after the last materialization exist only
-                # in the log — union those names in
-                names.update(rec[1] for rec in inner.get("log", ()))
-                inner = inner.get("mat") or {}
+                # in the log — union those names in. Inline format carries
+                # the log/mat; DSTL carries handles, so restore a scratch
+                # backend and take its table names
+                if inner.get("kind") == "changelog":
+                    names.update(rec[1] for rec in inner.get("log", ()))
+                    inner = inner.get("mat") or {}
+                else:
+                    from ..state.changelog import ChangelogKeyedStateBackend
+                    cb = ChangelogKeyedStateBackend(
+                        KeyGroupRange(0, (1 << 15) - 1), 1 << 15)
+                    cb.restore([inner])
+                    names.update(cb._states)
+                    inner = {}
             names.update(inner.get("states", {}))
         return sorted(names)
 
@@ -227,7 +237,7 @@ class SavepointWriter:
             op = (snap.get("chain") or {}).get(op_key) or {}
             keyed = op.get("keyed") or {}
             inner = keyed.get("backend", keyed)
-            if inner.get("kind") == "changelog":
+            if inner.get("kind") in ("changelog", "changelog-dstl"):
                 raise NotImplementedError(
                     "transforming changelog-backend state requires "
                     "materialization first (read + with_keyed_state)")

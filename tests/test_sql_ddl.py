@@ -207,6 +207,34 @@ def test_drop_temporary_view_registered_via_api():
                              t_env.execute_sql("SHOW TABLES").collect()]
 
 
+def test_insert_renames_aliased_columns_to_target_names():
+    """JSON encodes field names: an aliased SELECT must write the TARGET
+    table's column names (positional mapping, like the reference)."""
+    t_env = TableEnvironment()
+    _mk_bids(t_env, rows=50)
+    t_env.execute_sql("""
+        CREATE TABLE jsink (auction BIGINT, price BIGINT) WITH (
+            'connector'='log','topic'='renamed','broker'='ddl-rn',
+            'format'='json')""")
+    t_env.execute_sql("INSERT INTO jsink "
+                      "SELECT auction AS a, price AS p FROM bids")
+    t_env.execute_sql("""
+        CREATE TABLE jsrc (auction BIGINT, price BIGINT) WITH (
+            'connector'='log','topic'='renamed','broker'='ddl-rn',
+            'format'='json','bounded'='true')""")
+    got = t_env.execute_sql(
+        "SELECT SUM(auction), COUNT(*) FROM jsrc").collect_final()
+    assert got[0][1] == 50
+    assert got[0][0] > 0          # auction column decoded, not nulled
+
+
+def test_truncated_statements_raise_sql_error():
+    t_env = TableEnvironment()
+    for bad in ("CREATE VIEW v AS", "INSERT INTO t"):
+        with pytest.raises(SqlError):
+            t_env.execute_sql(bad)
+
+
 # -- error paths ------------------------------------------------------------
 
 def test_unknown_connector_fails_loud():

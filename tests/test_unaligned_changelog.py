@@ -15,6 +15,7 @@ from flink_tpu.core.records import RecordBatch, Schema
 from flink_tpu.runtime.channels import InputGate, LocalChannel
 from flink_tpu.state.changelog import ChangelogKeyedStateBackend
 from flink_tpu.state.descriptors import ValueStateDescriptor
+from flink_tpu.state.dstl import read_any_segment as _read_segment
 from flink_tpu.state.heap import HeapKeyedStateBackend
 
 SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
@@ -192,12 +193,15 @@ def test_changelog_snapshot_is_delta():
     for i in range(100):
         put(b, i, i * 2, desc)
     s1 = b.snapshot(1)               # first: materializes, log empty after
-    assert s1["kind"] == "changelog"
-    assert s1["log"] == []
+    assert s1["kind"] == "changelog-dstl"
+    assert s1["segments"] == []
     put(b, 5, 999, desc)
     s2 = b.snapshot(2)
-    assert len(s2["log"]) == 1       # O(delta), not O(state)
-    assert s2["mat"] is s1["mat"]    # shared materialized base
+    # O(delta), not O(state): exactly the one change past the base
+    recs = [r for h in s2["segments"]
+            for r in _read_segment(h) if r[0] > s2["base_seq"]]
+    assert len(recs) == 1
+    assert s2["base"] == s1["base"]  # base shared BY HANDLE, written once
 
 
 def test_changelog_restore_replays_log():
@@ -210,7 +214,9 @@ def test_changelog_restore_replays_log():
     b.set_current_key(2)
     b.get_partitioned_state(desc).clear()   # rm record
     snap = b.snapshot(2)
-    assert len(snap["log"]) == 3
+    recs = [r for h in snap["segments"]
+            for r in _read_segment(h) if r[0] > snap["base_seq"]]
+    assert len(recs) == 3
 
     b2 = make_changelog()
     b2.restore([snap])
@@ -230,7 +236,7 @@ def test_changelog_materialization_interval():
     put(b, 1, 3, desc)
     s3 = b.snapshot(3)               # interval reached -> materialize #2
     assert s1["mat_id"] == 1 and s2["mat_id"] == 1
-    assert s3["mat_id"] == 2 and s3["log"] == []
+    assert s3["mat_id"] == 2 and s3["segments"] == []
 
 
 def test_changelog_rescale_filters_key_groups():
@@ -284,3 +290,100 @@ def test_changelog_backend_via_registry_end_to_end():
     for k, c in out:
         finals[k] = max(finals.get(k, 0), c)
     assert finals == {i: 10 for i in range(5)}
+
+
+# -- DSTL storage: batching, truncation, durability -------------------------
+
+def test_dstl_fs_roundtrip_and_o_delta_bytes(tmp_path):
+    """File driver: base written once per materialization; a checkpoint
+    after a small change uploads a small segment (O(delta) on disk); a
+    fresh backend restores from the handles alone."""
+    import os
+
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    def mk(**kw):
+        b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                       materialization_interval=10, **kw)
+        b._store = FsChangelogStorage(str(tmp_path))
+        b._writer.store = b._store
+        return b
+
+    b = mk()
+    desc = ValueStateDescriptor("counter")
+    for i in range(5000):
+        put(b, i, i * 2, desc)
+    s1 = b.snapshot(1)
+    base_file = s1["base"]
+    base_size = os.path.getsize(base_file)
+    put(b, 7, 999, desc)
+    s2 = b.snapshot(2)
+    assert s2["base"] == base_file           # base not rewritten
+    seg_bytes = sum(os.path.getsize(h["location"])
+                    for h in s2["segments"])
+    assert seg_bytes < base_size / 50        # delta << state
+
+    b2 = mk()
+    b2.restore([s2])
+    b2.set_current_key(7)
+    assert b2.get_partitioned_state(desc).value() == 999
+    b2.set_current_key(4999)
+    assert b2.get_partitioned_state(desc).value() == 9998
+
+
+def test_dstl_batched_uploads_and_generational_truncation(tmp_path):
+    """Small flush threshold forces multiple segment uploads between
+    checkpoints. Materialization defers cleanup by one generation window:
+    a RETAINED checkpoint referencing the superseded base must still
+    restore; once enough newer generations exist, the old base + covered
+    segments are deleted from disk."""
+    import os
+
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=2,
+                                   flush_bytes=256)
+    b._store = FsChangelogStorage(str(tmp_path))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    b.snapshot(1)                            # materialize #1 (empty base)
+    for i in range(100):
+        put(b, i, i, desc)                   # >> 256 bytes: auto-flushes
+    assert b._writer.segments_uploaded > 1   # batched, not one blob
+    s2 = b.snapshot(2)
+    assert len(s2["segments"]) == b._writer.segments_uploaded
+    s3 = b.snapshot(3)                       # interval hit: materialize #2
+    assert s3["mat_id"] == 2 and s3["segments"] == []
+    # the RETAINED checkpoint s2 references generation-1 artifacts: they
+    # must survive the materialization and s2 must still restore
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2.restore([s2])
+    b2.set_current_key(42)
+    assert b2.get_partitioned_state(desc).value() == 42
+    # two more checkpoints -> materialize #3 -> generation 1 ages out
+    b.snapshot(4)
+    b.snapshot(5)
+    on_disk = [f for f in os.listdir(tmp_path) if f.startswith("seg-")]
+    assert on_disk == []                     # gen-1 segments deleted
+    bases = [f for f in os.listdir(tmp_path) if f.startswith("base-")]
+    assert len(bases) == 2                   # live + 1 kept generation
+
+
+def test_dstl_legacy_inline_snapshot_restores():
+    """Old-format ("kind": "changelog") snapshots from earlier builds still
+    restore (committed-fixture compatibility path)."""
+    import pickle as pk
+
+    from flink_tpu.core.keygroups import assign_to_key_group
+
+    legacy = {
+        "kind": "changelog", "mat_id": 1, "mat": None,
+        "log": [("put", "counter", assign_to_key_group(1, 128),
+                 pk.dumps((1, None, 42), protocol=pk.HIGHEST_PROTOCOL),
+                 None)]}
+    b = make_changelog()
+    b.restore([legacy])
+    b.set_current_key(1)
+    desc = ValueStateDescriptor("counter")
+    assert b.get_partitioned_state(desc).value() == 42
