@@ -1,0 +1,158 @@
+"""Network-backed log broker: the LogBroker contract over TCP.
+
+The in-memory broker (connectors/log.py) serves single-process tests; this
+pair makes the Kafka-shaped connector real across processes and hosts —
+``LogBrokerServer`` hosts topics (backed by an InMemoryLogBroker), and
+``RemoteLogBroker`` is a client implementing the same ``LogBroker``
+interface, so LogSource/LogSink work unchanged (reference: the Kafka
+cluster stands behind KafkaSource/KafkaSink the same way). Framing is the
+data plane's length-prefixed pickle (cluster/transport.py style); each
+client connection is served by its own thread, state lives in the broker
+under its lock.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+from .log import InMemoryLogBroker, LogBroker
+
+__all__ = ["LogBrokerServer", "RemoteLogBroker"]
+
+_MSG = struct.Struct("<I")
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_MSG.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Optional[Any]:
+    head = b""
+    while len(head) < _MSG.size:
+        chunk = sock.recv(_MSG.size - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = _MSG.unpack(head)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return pickle.loads(body)
+
+
+class LogBrokerServer:
+    """Serves a LogBroker over TCP. Methods are dispatched by name —
+    exactly the LogBroker surface, nothing else."""
+
+    _ALLOWED = {"partitions", "poll", "append", "append_txn", "end_offset",
+                "create_topic"}
+
+    def __init__(self, backing: Optional[LogBroker] = None, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.broker = backing or InMemoryLogBroker()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.host, self.port = self._srv.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, name="log-broker-accept",
+                         daemon=True).start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="log-broker-conn", daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = _recv(conn)
+                if msg is None:
+                    return
+                method, args = msg
+                try:
+                    if method not in self._ALLOWED:
+                        raise AttributeError(f"no broker method {method!r}")
+                    result = getattr(self.broker, method)(*args)
+                    _send(conn, ("ok", result))
+                except Exception as e:  # noqa: BLE001 - shipped to client
+                    _send(conn, ("err", f"{type(e).__name__}: {e}"))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class RemoteLogBroker(LogBroker):
+    """TCP client implementing LogBroker; one connection per instance,
+    calls serialized under a lock (readers/writers each own an instance)."""
+
+    def __init__(self, address: str, connect_timeout: float = 5.0):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(30.0)
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, *args):
+        with self._lock:
+            _send(self._sock, (method, args))
+            resp = _recv(self._sock)
+        if resp is None:
+            raise ConnectionError("log broker connection closed")
+        status, payload = resp
+        if status == "err":
+            raise RuntimeError(f"broker error: {payload}")
+        return payload
+
+    def create_topic(self, topic: str,
+                     num_partitions: Optional[int] = None) -> None:
+        self._call("create_topic", topic, num_partitions)
+
+    def partitions(self, topic: str) -> int:
+        return self._call("partitions", topic)
+
+    def poll(self, topic, partition, offset, max_records):
+        return self._call("poll", topic, partition, offset, max_records)
+
+    def append(self, topic, partition, payloads) -> None:
+        self._call("append", topic, partition, payloads)
+
+    def append_txn(self, txn_id, topic, partition, payloads) -> None:
+        self._call("append_txn", txn_id, topic, partition, payloads)
+
+    def end_offset(self, topic, partition) -> int:
+        return self._call("end_offset", topic, partition)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

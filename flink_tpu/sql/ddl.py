@@ -358,11 +358,18 @@ _BROKERS_LOCK = threading.Lock()
 
 
 def _broker(name: str):
-    from ..connectors.log import InMemoryLogBroker
+    """Named in-process broker, or a TCP client when the option looks like
+    host:port (the real-cluster path: a LogBrokerServer listens there)."""
     with _BROKERS_LOCK:
         b = _BROKERS.get(name)
         if b is None:
-            b = _BROKERS[name] = InMemoryLogBroker()
+            if ":" in name:     # cached per address: one connection, not
+                from ..connectors.log_net import RemoteLogBroker  # per stmt
+                b = RemoteLogBroker(name)
+            else:
+                from ..connectors.log import InMemoryLogBroker
+                b = InMemoryLogBroker()
+            _BROKERS[name] = b
         return b
 
 
@@ -375,7 +382,14 @@ def _format(options: dict, schema: Schema):
         return JsonFormat(schema)
     if fmt == "binary":
         return BinaryFormat(schema)
-    raise SqlError(f"unsupported format {fmt!r} (csv|json|binary)")
+    if fmt == "columnar":
+        from ..formats.columnar import ColumnarFormat
+        return ColumnarFormat(schema)
+    if fmt == "avro":
+        from ..formats.avro import AvroFormat
+        return AvroFormat(schema)
+    raise SqlError(f"unsupported format {fmt!r} "
+                   f"(csv|json|binary|columnar|avro)")
 
 
 def _watermark_strategy(entry: CatalogTable) -> Optional[WatermarkStrategy]:
@@ -440,8 +454,12 @@ def instantiate_source(env, entry: CatalogTable):
         return env.from_source(src, ws, entry.name)
     if connector == "log":
         from ..connectors.log import LogSource
+        fmt = _format(opts, entry.schema)
+        if getattr(fmt, "binary", False):
+            raise SqlError("log topics carry text lines; use csv|json "
+                           f"(table {entry.name!r})")
         src = LogSource(_broker(opts.get("broker", "default")),
-                        opts["topic"], _format(opts, entry.schema),
+                        opts["topic"], fmt,
                         bounded=opts.get("bounded", "false") == "true",
                         starting_offsets=opts.get("scan.startup.mode",
                                                   "earliest"))
@@ -469,9 +487,13 @@ def instantiate_sink(entry: CatalogTable):
         return FileSink(opts["path"], _format(opts, entry.schema))
     if connector == "log":
         from ..connectors.log import LogSink
+        fmt = _format(opts, entry.schema)
+        if getattr(fmt, "binary", False):
+            raise SqlError("log topics carry text lines; use csv|json "
+                           f"(table {entry.name!r})")
         broker = _broker(opts.get("broker", "default"))
         broker.create_topic(opts["topic"])
-        return LogSink(broker, opts["topic"], _format(opts, entry.schema))
+        return LogSink(broker, opts["topic"], fmt)
     if connector == "blackhole":
         from ..core.functions import SinkFunction
 

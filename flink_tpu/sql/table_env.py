@@ -256,15 +256,16 @@ class TableEnvironment:
         # names, so aliased query outputs must be renamed before the sink
         target_schema = target.schema
         src_names = out_schema.names
+        if src_names != target_schema.names:
+            def rename(batch: RecordBatch):
+                cols = {t: batch.columns[s]
+                        for s, t in zip(src_names, target_schema.names)}
+                return RecordBatch(target_schema, cols, batch.timestamps)
 
-        def rename(batch: RecordBatch):
-            cols = {t: batch.columns[s]
-                    for s, t in zip(src_names, target_schema.names)}
-            return RecordBatch(target_schema, cols, batch.timestamps)
-
-        from ..runtime.operators.simple import BatchFnOperator
-        stream = stream.transform(
-            "InsertRename", lambda: BatchFnOperator(rename, "InsertRename"))
+            from ..runtime.operators.simple import BatchFnOperator
+            stream = stream.transform(
+                "InsertRename",
+                lambda: BatchFnOperator(rename, "InsertRename"))
         sink = instantiate_sink(target)
         rows = _CountingSink()
         stream.add_sink(rows.wrap(sink), f"insert-{stmt.target}")

@@ -116,6 +116,7 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
         self._base_location = self._store.write_base(
             base_id, pickle.dumps(base, protocol=pickle.HIGHEST_PROTOCOL))
         self._base_seq = self._writer.last_seq
+        self._writer.drop_buffered()   # base covers them; don't upload dead
         covered = self._writer.detach(self._base_seq)
         if prev_base is not None:
             self._old_generations.append((prev_base, covered))

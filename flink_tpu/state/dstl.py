@@ -155,18 +155,20 @@ class InMemoryChangelogStorage(_Store):
 
 def read_any_segment(handle_dict: dict) -> list:
     """Reconstruct + read a segment from its serialized handle (restore may
-    happen in a fresh process that only has the checkpoint payload)."""
+    happen in a fresh process that only has the checkpoint payload). Pure
+    read: no storage object is constructed, so restoring from a read-only
+    replica of the checkpoint directory works."""
     h = SegmentHandle(**handle_dict)
     if h.driver == "fs":
-        return FsChangelogStorage(os.path.dirname(h.location)) \
-            .read_segment(h)
+        with open(h.location, "rb") as f:
+            return pickle.load(f)
     return InMemoryChangelogStorage().read_segment(h)
 
 
 def read_any_base(driver: str, location: str) -> bytes:
     if driver == "fs":
-        return FsChangelogStorage(os.path.dirname(location)) \
-            .read_base(location)
+        with open(location, "rb") as f:
+            return f.read()
     return InMemoryChangelogStorage().read_base(location)
 
 
@@ -226,18 +228,18 @@ class ChangelogWriter:
         self.flush()
         return [h for h in self._segments if h.to_seq > base_seq]
 
-    def truncate(self, base_seq: int) -> int:
-        """Delete segments fully covered by the materialized base; returns
-        how many were deleted (reference truncate after materialization)."""
-        dead = self.detach(base_seq)
-        for h in dead:
-            self.store.delete_segment(h)
-        return len(dead)
-
     def detach(self, base_seq: int) -> list[SegmentHandle]:
         """Remove segments covered by ``base_seq`` from the live list
         WITHOUT deleting them — the caller owns their deferred deletion
-        (retained checkpoints may still reference them)."""
+        (retained checkpoints may still reference them; deleting covered
+        segments eagerly is exactly the bug the generation retention in
+        the changelog backend exists to prevent)."""
         dead = [h for h in self._segments if h.to_seq <= base_seq]
         self._segments = [h for h in self._segments if h.to_seq > base_seq]
         return dead
+
+    def drop_buffered(self) -> None:
+        """Discard buffered (never-uploaded) records: a materialization
+        just covered them, so flushing them would upload a dead segment."""
+        self._buf = []
+        self._buf_bytes = 0

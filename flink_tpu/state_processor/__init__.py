@@ -107,11 +107,19 @@ class SavepointReader:
                     names.update(rec[1] for rec in inner.get("log", ()))
                     inner = inner.get("mat") or {}
                 else:
-                    from ..state.changelog import ChangelogKeyedStateBackend
-                    cb = ChangelogKeyedStateBackend(
-                        KeyGroupRange(0, (1 << 15) - 1), 1 << 15)
-                    cb.restore([inner])
-                    names.update(cb._states)
+                    # handles alone give the names: base pickle's table
+                    # keys + each log record's state-name slot — no full
+                    # restore just to list names
+                    import pickle as _pk
+
+                    from ..state.dstl import read_any_base, read_any_segment
+                    if inner.get("base") is not None:
+                        base = _pk.loads(read_any_base(
+                            inner["driver"], inner["base"]))
+                        names.update(base.get("states", {}))
+                    for h in inner.get("segments", []):
+                        names.update(rec[1] for _seq, rec
+                                     in read_any_segment(h))
                     inner = {}
             names.update(inner.get("states", {}))
         return sorted(names)
