@@ -5,9 +5,11 @@ rest/RestServerEndpoint.java:86, WebMonitorEndpoint.java:194, handlers under
 rest/handler/job/ incl. savepoint triggering SavepointHandlers.java:115),
 reduced to the operationally useful slice:
 
+    GET  /                        -> single-page web dashboard (webui.py)
     GET  /jobs                    -> running job overview
     GET  /jobs/<name>             -> vertices, parallelism, task states
     GET  /jobs/<name>/checkpoints -> completed checkpoint stats
+    GET  /jobs/<name>/flamegraph  -> sampled task-thread flamegraph trie
     POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
     GET  /metrics                 -> prometheus text exposition
 """
@@ -71,9 +73,20 @@ class RestEndpoint:
         coord = self._coordinators.get(name)
         if coord is None:
             return []
+        stats = {s["id"]: s for s in getattr(coord, "stats", [])}
         return [{"id": c.checkpoint_id, "savepoint": c.is_savepoint,
-                 "external_path": c.external_path}
+                 "external_path": c.external_path,
+                 "duration_s": stats.get(c.checkpoint_id, {}).get(
+                     "duration_s"),
+                 "tasks": stats.get(c.checkpoint_id, {}).get("tasks")}
                 for c in getattr(coord, "_completed", [])]
+
+    def _flamegraph(self, name: str) -> Optional[dict]:
+        job = self._jobs.get(name)
+        if job is None:
+            return None
+        from .webui import sample_flamegraph
+        return sample_flamegraph(job, duration_s=1.0)
 
     def _trigger_savepoint(self, name: str) -> tuple[int, dict]:
         coord = self._coordinators.get(name)
@@ -104,7 +117,21 @@ class RestEndpoint:
 
             def do_GET(self):  # noqa: N802
                 parts = [p for p in self.path.split("/") if p]
-                if parts == ["jobs"]:
+                if parts == []:
+                    from .webui import DASHBOARD_HTML
+                    body = DASHBOARD_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "flamegraph"):
+                    fg = endpoint._flamegraph(parts[1])
+                    self._reply(200 if fg else 404,
+                                fg or {"error": "no such job"})
+                elif parts == ["jobs"]:
                     self._reply(200, endpoint._job_overview())
                 elif len(parts) == 2 and parts[0] == "jobs":
                     detail = endpoint._job_detail(parts[1])

@@ -1,0 +1,278 @@
+"""Web dashboard, flamegraph sampling, history server.
+
+Reference: flink-runtime-web (Angular dashboard over the REST API),
+runtime/webmonitor/threadinfo/ (ThreadInfoSample -> VertexFlameGraph), and
+runtime/webmonitor/history/ (HistoryServer archiving completed jobs). The
+TPU-native build keeps the same architecture — a dashboard that is a pure
+REST client — but ships it as ONE self-contained HTML page (no build
+toolchain, no framework): topology, task states, checkpoint stats and an
+on-demand flamegraph, polling the endpoints cluster/rest.py already serves.
+
+Flamegraphs sample the PYTHON stacks of the job's task threads via
+``sys._current_frames()`` at a fixed rate and fold them into the d3-flame
+trie {name, value, children} (the reference samples JVM threads through
+ThreadMXBean — same shape, different VM). The host-side Python stack is
+where this framework's overhead lives (XLA kernels show as the dispatch
+frame), so this is the profiling view that matters for the hot loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+__all__ = ["sample_flamegraph", "archive_job", "HistoryServer",
+           "DASHBOARD_HTML"]
+
+
+# -- flamegraph -------------------------------------------------------------
+
+def _fold(root: dict, stack: list[str]) -> None:
+    root["value"] += 1
+    node = root
+    for frame in stack:
+        for child in node["children"]:
+            if child["name"] == frame:
+                node = child
+                break
+        else:
+            child = {"name": frame, "value": 0, "children": []}
+            node["children"].append(child)
+            node = child
+        node["value"] += 1
+
+
+def sample_flamegraph(job, duration_s: float = 1.0,
+                      hz: float = 50.0) -> dict:
+    """Sample the job's task threads; returns a d3-flamegraph trie."""
+    idents: dict[int, str] = {}
+    for task_id, task in job.tasks.items():
+        th = getattr(task, "_thread", None)
+        if th is not None and th.is_alive():
+            idents[th.ident] = task_id
+    root = {"name": "root", "value": 0, "children": []}
+    samples = 0
+    deadline = time.time() + duration_s
+    period = 1.0 / hz
+    while time.time() < deadline and idents:
+        frames = sys._current_frames()
+        for ident, task_id in idents.items():
+            frame = frames.get(ident)
+            if frame is None:
+                continue
+            stack: list[str] = []
+            while frame is not None:
+                code = frame.f_code
+                stack.append(f"{code.co_name} "
+                             f"({os.path.basename(code.co_filename)}:"
+                             f"{frame.f_lineno})")
+                frame = frame.f_back
+            stack.reverse()
+            _fold(root, [task_id] + stack)
+            samples += 1
+        time.sleep(period)
+    root["samples"] = samples
+    return root
+
+
+# -- history server ---------------------------------------------------------
+
+def archive_job(archive_dir: str, name: str, job,
+                coordinator=None) -> str:
+    """Write a completed job's terminal view to the archive (reference
+    HistoryServerArchivist / FsJobArchivist)."""
+    os.makedirs(archive_dir, exist_ok=True)
+    vertices = []
+    for vid, v in job.job_graph.vertices.items():
+        vertices.append({"id": vid, "name": v.name, "uid": v.uid,
+                         "parallelism": v.parallelism})
+    checkpoints = []
+    if coordinator is not None:
+        checkpoints = list(getattr(coordinator, "stats", []))
+    archive = {"name": name,
+               "state": "FAILED" if job.failed else "FINISHED",
+               "archived_at": time.time(),
+               "tasks": len(job.tasks),
+               "vertices": vertices,
+               "checkpoints": checkpoints}
+    path = os.path.join(archive_dir, f"{name}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(archive, f)
+    os.replace(tmp, path)
+    return path
+
+
+class HistoryServer:
+    """Serves archived completed jobs (reference
+    runtime/webmonitor/history/HistoryServer.java): GET /history lists,
+    GET /history/<name> returns one archive."""
+
+    def __init__(self, archive_dir: str, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.archive_dir = archive_dir
+        self._requested_port = port
+        self._host = host
+        self._server = None
+        self.port: Optional[int] = None
+
+    def _list(self) -> list[dict]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.archive_dir))
+        except OSError:
+            return []
+        for n in names:
+            if not n.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.archive_dir, n)) as f:
+                    a = json.load(f)
+                out.append({"name": a["name"], "state": a["state"],
+                            "archived_at": a["archived_at"]})
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def _get(self, name: str) -> Optional[dict]:
+        path = os.path.join(self.archive_dir, f"{name}.json")
+        if os.path.basename(path) != f"{name}.json" or "/" in name:
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def start(self) -> int:
+        import http.server
+
+        hs = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, code, payload, ctype="application/json"):
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode())
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["history"] or parts == []:
+                    self._reply(200, hs._list())
+                elif len(parts) == 2 and parts[0] == "history":
+                    a = hs._get(parts[1])
+                    self._reply(200 if a else 404,
+                                a or {"error": "no such archive"})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def log_message(self, *args):
+                pass
+
+        from ..utils.httpd import ThreadedHTTPServer
+        self._server = ThreadedHTTPServer(Handler, self._requested_port,
+                                          self._host, "history-server")
+        self.port = self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+# -- dashboard (single self-contained page; a pure REST client) -------------
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>flink-tpu</title><style>
+body{font:13px/1.5 system-ui,sans-serif;margin:0;background:#0f1320;
+color:#dfe6f4}
+h1{font-size:15px;margin:0;padding:10px 16px;background:#161b2e;
+border-bottom:1px solid #273052}
+h1 small{color:#7c89ad;font-weight:400;margin-left:8px}
+section{margin:14px 16px}
+h2{font-size:13px;color:#9fb0d8;margin:0 0 6px}
+table{border-collapse:collapse;width:100%;background:#141930;
+border:1px solid #273052}
+th,td{padding:5px 10px;text-align:left;border-bottom:1px solid #222a49}
+th{color:#8fa1c7;font-weight:600;font-size:12px}
+.ok{color:#6fe3a1}.run{color:#7cb5ff}.bad{color:#ff7d7d}
+.bar{display:inline-block;height:9px;background:#7cb5ff;border-radius:2px;
+vertical-align:middle}
+button{background:#27407a;color:#dfe6f4;border:0;border-radius:4px;
+padding:4px 10px;cursor:pointer}
+#flame div{overflow:hidden;white-space:nowrap;font-size:10px;
+border-radius:2px;margin-top:1px;padding:0 3px;color:#081020;
+background:#e8a33d;cursor:default}
+</style></head><body>
+<h1>flink-tpu <small>streaming dashboard</small></h1>
+<section><h2>Jobs</h2><table id="jobs"><thead><tr>
+<th>name</th><th>state</th><th>tasks</th><th>running</th></tr></thead>
+<tbody></tbody></table></section>
+<section><h2>Topology</h2><table id="topo"><thead><tr>
+<th>vertex</th><th>name</th><th>parallelism</th><th>subtasks</th>
+</tr></thead><tbody></tbody></table></section>
+<section><h2>Checkpoints</h2><table id="ckpts"><thead><tr>
+<th>id</th><th>savepoint</th><th>duration (s)</th><th>tasks</th>
+</tr></thead><tbody></tbody></table></section>
+<section><h2>Flamegraph
+<button onclick="flame()">sample 1s</button></h2>
+<div id="flame"></div></section>
+<script>
+let current=null;
+async function j(p){const r=await fetch(p);return r.json()}
+function cls(s){return s==="RUNNING"?"run":s==="FAILED"?"bad":"ok"}
+async function refresh(){
+  const jobs=await j('/jobs');
+  const tb=document.querySelector('#jobs tbody');tb.innerHTML='';
+  for(const job of jobs){
+    if(!current)current=job.name;
+    tb.insertAdjacentHTML('beforeend',
+      `<tr><td>${job.name}</td><td class=${cls(job.state)}>${job.state}
+       </td><td>${job.tasks}</td><td>${job.running_tasks}</td></tr>`)}
+  if(!current)return;
+  const d=await j('/jobs/'+current);
+  const tt=document.querySelector('#topo tbody');tt.innerHTML='';
+  for(const v of (d.vertices||[])){
+    const subs=v.subtasks.map(s=>
+      `<span class=${cls(s.state)}>&#9632;</span>`).join(' ');
+    tt.insertAdjacentHTML('beforeend',
+      `<tr><td>${v.id}</td><td>${v.name}</td><td>${v.parallelism}</td>
+       <td>${subs}</td></tr>`)}
+  const cs=await j('/jobs/'+current+'/checkpoints');
+  const tc=document.querySelector('#ckpts tbody');tc.innerHTML='';
+  for(const c of cs.slice(-12).reverse()){
+    tc.insertAdjacentHTML('beforeend',
+      `<tr><td>${c.id}</td><td>${c.savepoint||false}</td>
+       <td>${(c.duration_s||0).toFixed(3)}</td><td>${c.tasks||''}</td>
+       </tr>`)}
+}
+function renderFlame(node,total,el,depth){
+  if(!total)return;
+  const w=100*node.value/total;
+  if(w<0.5)return;
+  const d=document.createElement('div');
+  d.style.width=w+'%';d.style.marginLeft=(depth*4)+'px';
+  d.title=node.name+' — '+node.value+' samples';
+  d.textContent=node.name;
+  el.appendChild(d);
+  for(const c of (node.children||[]))renderFlame(c,total,el,depth+1);
+}
+async function flame(){
+  if(!current)return;
+  const el=document.getElementById('flame');
+  el.innerHTML='<em>sampling…</em>';
+  const f=await j('/jobs/'+current+'/flamegraph');
+  el.innerHTML='';
+  renderFlame(f,f.value,el,0);
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""

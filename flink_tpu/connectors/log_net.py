@@ -64,6 +64,8 @@ class LogBrokerServer:
         self._srv.listen(64)
         self.host, self.port = self._srv.getsockname()
         self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         threading.Thread(target=self._accept, name="log-broker-accept",
                          daemon=True).start()
 
@@ -77,6 +79,8 @@ class LogBrokerServer:
                 conn, _ = self._srv.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              name="log-broker-conn", daemon=True).start()
 
@@ -97,8 +101,21 @@ class LogBrokerServer:
         except OSError:
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
+            except OSError:
+                pass
+
+    def drop_connections(self) -> None:
+        """Sever every live client connection (listener stays up) — the
+        broker-restart simulation clients must survive by reconnecting."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
             except OSError:
                 pass
 
@@ -108,29 +125,58 @@ class LogBrokerServer:
             self._srv.close()
         except OSError:
             pass
+        self.drop_connections()
 
 
 class RemoteLogBroker(LogBroker):
-    """TCP client implementing LogBroker; one connection per instance,
-    calls serialized under a lock (readers/writers each own an instance)."""
+    """TCP client implementing LogBroker. Calls serialize under a lock on
+    one connection (parallel subtasks sharing an instance contend — the
+    correctness tradeoff of the simple framing; heavy fan-in should give
+    each reader its own instance). There is no request id on the wire, so
+    after ANY send/recv failure the connection may hold a stale response —
+    it is closed immediately and the next call reconnects fresh."""
 
     def __init__(self, address: str, connect_timeout: float = 5.0):
-        host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(30.0)
+        self._address = address
+        self._connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
+        host, port = self._address.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=self._connect_timeout)
+        self._sock.settimeout(30.0)
 
     def _call(self, method: str, *args):
         with self._lock:
-            _send(self._sock, (method, args))
-            resp = _recv(self._sock)
-        if resp is None:
-            raise ConnectionError("log broker connection closed")
+            try:
+                if self._sock is None:
+                    self._connect()
+                _send(self._sock, (method, args))
+                resp = _recv(self._sock)
+            except (OSError, ConnectionError):
+                # the stream may now hold a half-written request or an
+                # unread response: poison — drop the connection so the
+                # next call starts clean instead of reading stale frames
+                self._teardown()
+                raise
+            if resp is None:
+                self._teardown()
+                raise ConnectionError("log broker connection closed")
         status, payload = resp
         if status == "err":
             raise RuntimeError(f"broker error: {payload}")
         return payload
+
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def create_topic(self, topic: str,
                      num_partitions: Optional[int] = None) -> None:

@@ -256,7 +256,10 @@ class TableEnvironment:
         # names, so aliased query outputs must be renamed before the sink
         target_schema = target.schema
         src_names = out_schema.names
-        if src_names != target_schema.names:
+        # rebuild batches whenever names OR dtypes differ: RecordBatch
+        # construction against the target schema both renames positionally
+        # and coerces column dtypes to the sink's declared types
+        if out_schema.fields != target_schema.fields:
             def rename(batch: RecordBatch):
                 cols = {t: batch.columns[s]
                         for s, t in zip(src_names, target_schema.names)}

@@ -16,8 +16,9 @@ Model:
   checkpoint persists — the batching that keeps small-file pressure off the
   object store;
 * ``persist(base_seq)`` returns handles for all live segments past the
-  materialization point; ``truncate(base_seq)`` deletes segments fully
-  below it (they are covered by the base, no checkpoint can need them);
+  materialization point; ``detach(base_seq)`` hands covered segments to
+  the caller, which OWNS their deferred deletion (retained checkpoints may
+  still reference them — see the changelog backend's generation retention);
 * materialized bases are stored once per materialization and referenced by
   handle.
 

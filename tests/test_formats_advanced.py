@@ -204,3 +204,50 @@ def test_sql_over_network_broker_end_to_end():
         assert got[0][0] == 400
     finally:
         srv.close()
+
+
+def test_insert_coerces_dtypes_to_target_schema():
+    """Same names, different dtype: the sink's declared type wins (float
+    query output into a BIGINT column truncates, and the file reads back)."""
+    from flink_tpu.sql import TableEnvironment
+
+    t = TableEnvironment()
+    t.execute_sql("""
+        CREATE TABLE src (k BIGINT, v BIGINT) WITH (
+            'connector'='datagen','number-of-rows'='60')""")
+    t.execute_sql("""
+        CREATE TABLE csink (k BIGINT, v BIGINT) WITH (
+            'connector'='log','topic'='coerce','broker'='fmt-co',
+            'format'='csv')""")
+    # AVG over a window? simplest float producer: v / 2 keeps the name v
+    t.execute_sql("INSERT INTO csink SELECT k, v / 2 AS v FROM src")
+    t.execute_sql("""
+        CREATE TABLE csrc (k BIGINT, v BIGINT) WITH (
+            'connector'='log','topic'='coerce','broker'='fmt-co',
+            'format'='csv','bounded'='true')""")
+    got = t.execute_sql("SELECT COUNT(*) FROM csrc").collect_final()
+    assert got[0][0] == 60
+
+
+def test_remote_broker_reconnects_after_connection_loss():
+    """A failed call poisons the connection (no request ids on the wire):
+    the client must tear it down and reconnect fresh on the next call
+    rather than reading stale frames."""
+    from flink_tpu.connectors.log_net import LogBrokerServer, RemoteLogBroker
+
+    srv = LogBrokerServer()
+    c = RemoteLogBroker(srv.address)
+    try:
+        c.create_topic("r", 1)
+        c.append("r", 0, ["x"])
+        srv.drop_connections()               # broker "restart"
+        with pytest.raises((OSError, ConnectionError, RuntimeError)):
+            c.end_offset("r", 0)
+        assert c._sock is None               # poisoned socket torn down
+        # next call reconnects and sees consistent broker state
+        assert c.end_offset("r", 0) == 1
+        c.append("r", 0, ["y"])
+        assert c.poll("r", 0, 0, 10) == [(0, "x"), (1, "y")]
+    finally:
+        c.close()
+        srv.close()

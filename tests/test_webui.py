@@ -1,0 +1,121 @@
+"""Web dashboard, flamegraph sampling, history server (reference test
+models: flink-runtime-web handlers, JobManagerThreadInfoHandlerTest,
+HistoryServerTest)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.connectors.core import CollectSink
+from flink_tpu.core.config import PipelineOptions
+from flink_tpu.core.records import Schema
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _running_job(n=300_000):
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.config.set(PipelineOptions.BATCH_SIZE, 64)
+
+    def gen(idx):
+        return {"k": idx % 5, "v": idx}
+
+    ds = env.datagen(gen, SCHEMA, count=n, rate_per_sec=50_000.0)
+    ds.key_by("k").sum(1).add_sink(CollectSink(), "s")
+    return env, env.execute_async("ui-job")
+
+
+def test_dashboard_and_flamegraph():
+    from flink_tpu.cluster.rest import RestEndpoint
+
+    env, job = _running_job()
+    ep = RestEndpoint(port=0)
+    ep.register_job("ui-job", job)
+    port = ep.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        status, body = _get(f"{base}/")
+        assert status == 200
+        assert "<!doctype html" in body.lower()
+        assert "/jobs" in body and "flamegraph" in body
+
+        status, body = _get(f"{base}/jobs/ui-job/flamegraph")
+        fg = json.loads(body)
+        assert status == 200
+        assert fg["name"] == "root" and fg["samples"] > 0
+        # task ids are the first level; real frames below them
+        assert fg["children"]
+        first = fg["children"][0]
+        assert "#" in first["name"]
+        assert first["children"], "no stack frames under task"
+
+        status, body = _get(f"{base}/jobs/nope/flamegraph")
+        assert status == 404
+    finally:
+        ep.stop()
+        job.cancel()
+
+
+def test_history_server_archives_completed_job(tmp_path):
+    from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+    from flink_tpu.cluster.webui import HistoryServer, archive_job
+    from flink_tpu.core.config import CheckpointingOptions
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    env.config.set(PipelineOptions.BATCH_SIZE, 16)
+    env.config.set(CheckpointingOptions.INTERVAL, 0.05)
+    rows = [(i % 3, i) for i in range(2000)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(2000)))
+    ds.key_by("k").sum(1).add_sink(CollectSink(), "s")
+    job = env.execute("hist-job", timeout=60.0)
+    coord = getattr(job, "coordinator", None)
+
+    archive_dir = str(tmp_path / "archive")
+    archive_job(archive_dir, "hist-job", job, coord)
+
+    hs = HistoryServer(archive_dir, port=0)
+    port = hs.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        status, body = _get(f"{base}/history")
+        listing = json.loads(body)
+        assert status == 200
+        assert listing[0]["name"] == "hist-job"
+        assert listing[0]["state"] == "FINISHED"
+
+        status, body = _get(f"{base}/history/hist-job")
+        a = json.loads(body)
+        assert status == 200
+        assert a["tasks"] >= 1 and a["vertices"]
+
+        status, _ = _get(f"{base}/history/unknown")
+        assert status == 404
+    finally:
+        hs.stop()
+
+
+def test_flamegraph_fold_shape():
+    from flink_tpu.cluster.webui import _fold
+
+    root = {"name": "root", "value": 0, "children": []}
+    _fold(root, ["a", "b"])
+    _fold(root, ["a", "b"])
+    _fold(root, ["a", "c"])
+    assert root["value"] == 3
+    a = root["children"][0]
+    assert a["name"] == "a" and a["value"] == 3
+    names = {c["name"]: c["value"] for c in a["children"]}
+    assert names == {"b": 2, "c": 1}
