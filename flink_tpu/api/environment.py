@@ -35,6 +35,7 @@ class StreamExecutionEnvironment:
         self._sinks: list[Transformation] = []
         self.last_job = None
         self._restore_path: Optional[str] = None
+        self._remote_target: Optional[str] = None
 
     @staticmethod
     def get_execution_environment(
@@ -150,13 +151,34 @@ class StreamExecutionEnvironment:
         self.config.set(PipelineOptions.NAME, name)
         return build_job_graph(self.get_stream_graph(), self.config, name)
 
+    def set_remote_target(self, address: Optional[str]) -> None:
+        """Route execute() to a running session cluster's Dispatcher at
+        ``host:port`` instead of running in-process (reference
+        execution.target=remote + RestClusterClient; the CLI's --target
+        flag sets this)."""
+        self._remote_target = address
+
     def execute(self, job_name: str = "flink-tpu-job",
                 timeout: Optional[float] = 120.0,
                 metrics_registry=None, recover: bool = False):
         """Compile and run locally, blocking until completion (bounded
         sources) — reference execute():2309. With ``recover=True`` the job
         runs under a JobSupervisor that restarts from the latest completed
-        checkpoint on task failure (requires enable_checkpointing)."""
+        checkpoint on task failure (requires enable_checkpointing). With a
+        remote target set, the graph is submitted to the session cluster
+        and this blocks until the remote job is terminal."""
+        if self._remote_target:
+            from ..cluster.dispatcher import ClusterClient
+            client = ClusterClient(self._remote_target)
+            # a pending savepoint restore ships with the submission — the
+            # remote supervisor starts the job from it, matching the local
+            # path's semantics
+            restore = self._take_restore_checkpoint()
+            job_id = client.submit(self, name=job_name, restore=restore)
+            self._transformations = []
+            self._sinks = []
+            self.last_job = None
+            return client.wait(job_id, timeout=timeout)
         jg = self.get_job_graph(job_name)
         cp = self._take_restore_checkpoint()
         if recover:
@@ -182,6 +204,11 @@ class StreamExecutionEnvironment:
 
     def execute_async(self, job_name: str = "flink-tpu-job",
                       metrics_registry=None):
+        if self._remote_target:
+            raise RuntimeError(
+                "a remote target is set; execute_async runs in-process — "
+                "use execute() (which submits to the cluster and waits) or "
+                "ClusterClient.submit for fire-and-forget")
         from ..cluster.local import deploy_local
         jg = self.get_job_graph(job_name)
         cp = self._take_restore_checkpoint()

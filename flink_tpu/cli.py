@@ -43,6 +43,9 @@ def _cmd_run(args) -> int:
                        args.checkpoint_interval)
     if args.from_savepoint:
         env.restore_from_savepoint(args.from_savepoint)
+    if args.target:
+        # submit to a running session cluster instead of running in-process
+        env.set_remote_target(args.target)
     try:
         runpy.run_path(args.script, run_name="__main__")
     except SystemExit as e:
@@ -72,6 +75,23 @@ def _cmd_savepoint_info(args) -> int:
     return 0
 
 
+def _cmd_cluster(args) -> int:
+    import time
+
+    from .cluster.dispatcher import Dispatcher
+
+    d = Dispatcher(port=args.port, host=args.host,
+                   archive_dir=args.archive_dir or None)
+    port = d.start()
+    print(f"session cluster listening on {args.host}:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        d.stop()
+        return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="flink-tpu", description="flink-tpu command line client")
@@ -84,7 +104,17 @@ def main(argv: Optional[list[str]] = None) -> int:
     run.add_argument("--checkpoint-dir", default="")
     run.add_argument("--checkpoint-interval", type=float, default=0.0)
     run.add_argument("--from-savepoint", default="")
+    run.add_argument("--target", default="",
+                     help="host:port of a running session cluster "
+                          "(flink-tpu cluster); empty = run locally")
     run.set_defaults(fn=_cmd_run)
+
+    cluster = sub.add_parser(
+        "cluster", help="start a standing session cluster (Dispatcher)")
+    cluster.add_argument("--port", type=int, default=8081)
+    cluster.add_argument("--host", default="127.0.0.1")
+    cluster.add_argument("--archive-dir", default="")
+    cluster.set_defaults(fn=_cmd_cluster)
 
     spi = sub.add_parser("savepoint-info", help="inspect a savepoint")
     spi.add_argument("path")

@@ -1,0 +1,296 @@
+"""Session-cluster dispatcher + job-submission client.
+
+Reference semantics (SURVEY §2.3/§3.1): a client serializes the JobGraph
+and POSTs it to a standing cluster's Dispatcher (Dispatcher.submitJob:514
+behind RestServerEndpoint); the dispatcher spawns one master per job
+(JobManagerRunner -> JobMaster), tracks execution, and serves status/
+cancel/savepoint calls. Here the standing process is a ``Dispatcher``
+serving HTTP:
+
+    POST /jobs                        body = cloudpickled (JobGraph, config)
+                                      -> {"job_id": ...}
+    GET  /jobs                        -> [{job_id, name, state}]
+    GET  /jobs/<id>                   -> {state, error?, attempts}
+    POST /jobs/<id>/cancel            -> {"state": "CANCELLED"}
+    POST /jobs/<id>/savepoints        -> {"id", "external_path"}
+
+and the client is ``ClusterClient`` — build a pipeline locally, then
+``ClusterClient(addr).submit(env)`` instead of ``env.execute()``
+(reference ClusterClient/RestClusterClient). Job graphs ship as
+cloudpickle exactly like the reference ships serialized JobGraphs in the
+submit body; each accepted job runs under its own JobSupervisor thread
+(restart strategies + checkpointing per the job's config), and completed
+jobs can be archived for the history server.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Any, Optional
+
+try:
+    import cloudpickle as _pickle
+except ImportError:  # pragma: no cover - cloudpickle ships in the image
+    _pickle = pickle
+
+__all__ = ["Dispatcher", "ClusterClient"]
+
+
+class _JobRun:
+    def __init__(self, job_id: str, name: str):
+        self.job_id = job_id
+        self.name = name
+        self.state = "CREATED"     # CREATED/RUNNING/FINISHED/FAILED/CANCELLED
+        self.error: Optional[str] = None
+        self.supervisor = None
+        self.thread: Optional[threading.Thread] = None
+        self.started_at = time.time()
+
+
+class Dispatcher:
+    """Standing session cluster: accepts serialized job graphs and runs
+    each under its own supervisor."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 archive_dir: Optional[str] = None,
+                 job_timeout_s: float = 3600.0):
+        self._host = host
+        self._requested_port = port
+        self.archive_dir = archive_dir
+        self.job_timeout_s = job_timeout_s
+        self._jobs: dict[str, _JobRun] = {}
+        self._lock = threading.Lock()
+        self._server = None
+        self.port: Optional[int] = None
+
+    # -- job lifecycle -----------------------------------------------------
+    def submit(self, job_graph, config, restore=None) -> str:
+        """``restore`` starts the job from a shipped savepoint/checkpoint
+        (the client's --from-savepoint path; reference 'run -s')."""
+        from .scheduler import JobSupervisor
+
+        job_id = uuid.uuid4().hex[:12]
+        run = _JobRun(job_id, getattr(job_graph, "name", "job"))
+        run.supervisor = JobSupervisor(job_graph, config)
+        with self._lock:
+            self._jobs[job_id] = run
+
+        def drive():
+            run.state = "RUNNING"
+            try:
+                run.supervisor.run(timeout=self.job_timeout_s,
+                                   initial_restore=restore)
+                if run.state != "CANCELLED":
+                    run.state = "FINISHED"
+            except Exception as e:  # noqa: BLE001 - recorded for the client
+                if run.state != "CANCELLED":
+                    run.state = "FAILED"
+                    run.error = f"{type(e).__name__}: {e}"
+            finally:
+                if self.archive_dir and run.supervisor.current_job:
+                    from .webui import archive_job
+                    try:
+                        archive_job(self.archive_dir,
+                                    f"{run.name}-{job_id}",
+                                    run.supervisor.current_job,
+                                    run.supervisor.coordinator)
+                    except OSError:
+                        pass
+
+        run.thread = threading.Thread(target=drive, daemon=True,
+                                      name=f"job-{job_id}")
+        run.thread.start()
+        return job_id
+
+    def cancel(self, job_id: str) -> bool:
+        run = self._jobs.get(job_id)
+        if run is None:
+            return False
+        run.state = "CANCELLED"
+        sup = run.supervisor
+        if sup is not None:
+            # stop the supervisor's restart loop from resurrecting it
+            sup.restart_strategy = _NeverRestart()
+            if sup.coordinator is not None:
+                sup.coordinator.stop()
+            if sup.current_job is not None:
+                sup.current_job.cancel()
+        return True
+
+    def status(self, job_id: str) -> Optional[dict]:
+        run = self._jobs.get(job_id)
+        if run is None:
+            return None
+        return {"job_id": run.job_id, "name": run.name, "state": run.state,
+                "error": run.error,
+                "attempts": getattr(run.supervisor, "attempt", 0)}
+
+    def overview(self) -> list[dict]:
+        with self._lock:
+            return [{"job_id": r.job_id, "name": r.name, "state": r.state}
+                    for r in self._jobs.values()]
+
+    def _savepoint(self, job_id: str) -> tuple[int, dict]:
+        run = self._jobs.get(job_id)
+        if run is None:
+            return 404, {"error": "no such job"}
+        coord = getattr(run.supervisor, "coordinator", None)
+        if coord is None or run.state != "RUNNING":
+            return 409, {"error": f"job is {run.state}"}
+        sp = coord.trigger_savepoint(timeout=60.0)
+        return 200, {"id": sp.checkpoint_id,
+                     "external_path": sp.external_path}
+
+    # -- http --------------------------------------------------------------
+    def start(self) -> int:
+        import http.server
+
+        dispatcher = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["jobs"]:
+                    self._reply(200, dispatcher.overview())
+                elif len(parts) == 2 and parts[0] == "jobs":
+                    st = dispatcher.status(parts[1])
+                    self._reply(200 if st else 404,
+                                st or {"error": "no such job"})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):  # noqa: N802
+                parts = [p for p in self.path.split("/") if p]
+                try:
+                    if parts == ["jobs"]:
+                        n = int(self.headers.get("Content-Length", 0))
+                        payload = _pickle.loads(self.rfile.read(n))
+                        jg, config = payload[0], payload[1]
+                        restore = payload[2] if len(payload) > 2 else None
+                        job_id = dispatcher.submit(jg, config, restore)
+                        self._reply(200, {"job_id": job_id})
+                    elif (len(parts) == 3 and parts[0] == "jobs"
+                          and parts[2] == "cancel"):
+                        ok = dispatcher.cancel(parts[1])
+                        self._reply(200 if ok else 404,
+                                    {"state": "CANCELLED"} if ok
+                                    else {"error": "no such job"})
+                    elif (len(parts) == 3 and parts[0] == "jobs"
+                          and parts[2] == "savepoints"):
+                        code, payload = dispatcher._savepoint(parts[1])
+                        self._reply(code, payload)
+                    else:
+                        self._reply(404, {"error": "unknown path"})
+                except Exception as e:  # noqa: BLE001 - report to client
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def log_message(self, *args):
+                pass
+
+        from ..utils.httpd import ThreadedHTTPServer
+        self._server = ThreadedHTTPServer(Handler, self._requested_port,
+                                          self._host, "dispatcher")
+        self.port = self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        with self._lock:
+            ids = list(self._jobs)
+        for job_id in ids:
+            self.cancel(job_id)
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+
+class _NeverRestart:
+    def can_restart(self) -> bool:
+        return False
+
+    def backoff_seconds(self) -> float:
+        return 0.0
+
+    def notify_failure(self) -> None:
+        pass
+
+
+class ClusterClient:
+    """Submit locally-built pipelines to a running Dispatcher
+    (reference RestClusterClient)."""
+
+    def __init__(self, address: str):
+        self.address = address
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.address}{path}"
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(self._url(path), timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    def _post(self, path: str, body: bytes = b"") -> dict:
+        req = urllib.request.Request(self._url(path), data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read().decode())
+
+    def submit(self, env_or_graph, config=None, name: str = "job",
+               restore=None) -> str:
+        """Ship the pipeline to the cluster; returns the job id. Accepts a
+        StreamExecutionEnvironment (graph built from its transformations)
+        or a prebuilt JobGraph + config. ``restore`` ships a savepoint/
+        checkpoint the remote supervisor starts from."""
+        if hasattr(env_or_graph, "get_job_graph"):
+            config = env_or_graph.config
+            jg = env_or_graph.get_job_graph(name)
+        else:
+            jg = env_or_graph
+            if config is None:
+                raise ValueError("config required with a raw JobGraph")
+        body = _pickle.dumps((jg, config, restore),
+                             protocol=pickle.HIGHEST_PROTOCOL)
+        return self._post("/jobs", body)["job_id"]
+
+    def status(self, job_id: str) -> dict:
+        return self._get(f"/jobs/{job_id}")
+
+    def list_jobs(self) -> list[dict]:
+        return self._get("/jobs")
+
+    def cancel(self, job_id: str) -> None:
+        self._post(f"/jobs/{job_id}/cancel")
+
+    def trigger_savepoint(self, job_id: str) -> dict:
+        return self._post(f"/jobs/{job_id}/savepoints")
+
+    def wait(self, job_id: str, timeout: Optional[float] = 300.0,
+             poll_s: float = 0.1) -> dict:
+        """Block until the job reaches a terminal state; raises on FAILED.
+        ``timeout=None`` waits without bound (matching local execute)."""
+        deadline = None if timeout is None else time.time() + timeout
+        while deadline is None or time.time() < deadline:
+            st = self.status(job_id)
+            if st["state"] in ("FINISHED", "FAILED", "CANCELLED"):
+                if st["state"] == "FAILED":
+                    raise RuntimeError(
+                        f"job {job_id} failed: {st.get('error')}")
+                return st
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {job_id} not terminal within {timeout}s")

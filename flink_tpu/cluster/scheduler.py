@@ -111,8 +111,8 @@ class JobSupervisor:
                 self.restart_strategy.notify_failure()
                 if not self.restart_strategy.can_restart():
                     raise RuntimeError(
-                        f"Job failed terminally after {self.attempt} attempts"
-                    ) from e
+                        f"Job failed terminally after {self.attempt} "
+                        f"attempts: {e}") from e
                 job.cancel()
                 time.sleep(self.restart_strategy.backoff_seconds())
                 restore = self._latest
