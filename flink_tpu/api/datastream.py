@@ -204,6 +204,22 @@ class DataStream:
     def connect(self, other: "DataStream") -> "ConnectedStreams":
         return ConnectedStreams(self.env, self, other)
 
+    def iterate(self, max_wait_s: float = 2.0) -> "IterativeStream":
+        """Open a feedback loop (reference DataStream.iterate +
+        StreamIterationHead/Tail): build the loop body on the returned
+        stream, then ``close_with(feedback_stream)`` to route records back
+        into the head. The head terminates once this stream's regular
+        input finished and the loop stayed quiet for ``max_wait_s``.
+        Iterations are not checkpointable (deploy rejects the combination
+        with periodic checkpointing, matching the reference's exclusion of
+        loop state from exactly-once guarantees)."""
+        from ..graph.transformations import FeedbackTransformation
+        t = FeedbackTransformation(name="iteration",
+                                   inputs=[self.transformation],
+                                   max_wait_s=max_wait_s)
+        self.env._transformations.append(t)
+        return IterativeStream(self.env, t)
+
     # -- side outputs ------------------------------------------------------
     def get_side_output(self, tag: str) -> "DataStream":
         t = SideOutputTransformation(name=f"side-{tag}", tag=tag,
@@ -296,6 +312,17 @@ class DataStream:
                 pass  # replaced by generated watermarks
 
         return self._one_input("TimestampsWatermarks", _WmOperator)
+
+
+class IterativeStream(DataStream):
+    """Head of a feedback loop; ``close_with`` registers the back edge."""
+
+    def close_with(self, feedback: "DataStream") -> "DataStream":
+        """Route ``feedback``'s records back into the loop head; returns
+        ``feedback`` so the terminating/output branch can continue from it
+        (reference IterativeStream.closeWith)."""
+        self.transformation.feedback_inputs.append(feedback.transformation)
+        return feedback
 
 
 class KeyedStream(DataStream):

@@ -79,6 +79,15 @@ class CheckpointCoordinator:
     # -- trigger -----------------------------------------------------------
     def trigger_checkpoint(self, is_savepoint: bool = False) -> _Pending:
         """reference triggerCheckpoint:571 — inject barriers at sources."""
+        jg = getattr(self.job, "job_graph", None)
+        if jg is not None and any(getattr(e, "feedback", False)
+                                  for e in jg.edges):
+            # a barrier cannot circulate a feedback loop (the back edge
+            # drops barriers by design): refuse instead of wedging the
+            # iteration head's alignment forever
+            raise ValueError(
+                "iteration jobs (feedback edges) cannot be checkpointed "
+                "or savepointed")
         with self._lock:
             cid = self._next_id
             self._next_id += 1

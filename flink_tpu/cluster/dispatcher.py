@@ -42,6 +42,8 @@ __all__ = ["Dispatcher", "ClusterClient"]
 
 
 class _JobRun:
+    TERMINAL = ("FINISHED", "FAILED", "CANCELLED")
+
     def __init__(self, job_id: str, name: str):
         self.job_id = job_id
         self.name = name
@@ -50,6 +52,16 @@ class _JobRun:
         self.supervisor = None
         self.thread: Optional[threading.Thread] = None
         self.started_at = time.time()
+        self.lock = threading.Lock()   # guards state transitions
+
+    def transition(self, to: str, only_from: Optional[tuple] = None) -> bool:
+        with self.lock:
+            if self.state in self.TERMINAL:
+                return False
+            if only_from is not None and self.state not in only_from:
+                return False
+            self.state = to
+            return True
 
 
 class Dispatcher:
@@ -81,18 +93,20 @@ class Dispatcher:
             self._jobs[job_id] = run
 
         def drive():
-            run.state = "RUNNING"
+            if not run.transition("RUNNING", only_from=("CREATED",)):
+                return  # cancelled before the thread was scheduled
             try:
                 run.supervisor.run(timeout=self.job_timeout_s,
                                    initial_restore=restore)
-                if run.state != "CANCELLED":
-                    run.state = "FINISHED"
+                run.transition("FINISHED")
             except Exception as e:  # noqa: BLE001 - recorded for the client
-                if run.state != "CANCELLED":
-                    run.state = "FAILED"
+                if run.transition("FAILED"):
                     run.error = f"{type(e).__name__}: {e}"
             finally:
-                if self.archive_dir and run.supervisor.current_job:
+                # cancelled runs carry partial results: never archive them
+                # as a clean completion
+                if (self.archive_dir and run.supervisor.current_job
+                        and run.state != "CANCELLED"):
                     from .webui import archive_job
                     try:
                         archive_job(self.archive_dir,
@@ -107,11 +121,14 @@ class Dispatcher:
         run.thread.start()
         return job_id
 
-    def cancel(self, job_id: str) -> bool:
+    def cancel(self, job_id: str) -> Optional[bool]:
+        """True = cancelled; False = already terminal (a finished/failed
+        job keeps its state); None = no such job."""
         run = self._jobs.get(job_id)
         if run is None:
+            return None
+        if not run.transition("CANCELLED"):
             return False
-        run.state = "CANCELLED"
         sup = run.supervisor
         if sup is not None:
             # stop the supervisor's restart loop from resurrecting it
@@ -185,9 +202,14 @@ class Dispatcher:
                     elif (len(parts) == 3 and parts[0] == "jobs"
                           and parts[2] == "cancel"):
                         ok = dispatcher.cancel(parts[1])
-                        self._reply(200 if ok else 404,
-                                    {"state": "CANCELLED"} if ok
-                                    else {"error": "no such job"})
+                        if ok is None:
+                            self._reply(404, {"error": "no such job"})
+                        elif ok is False:
+                            st = dispatcher.status(parts[1])
+                            self._reply(409, {"error": "job is already "
+                                              f"{st['state']}"})
+                        else:
+                            self._reply(200, {"state": "CANCELLED"})
                     elif (len(parts) == 3 and parts[0] == "jobs"
                           and parts[2] == "savepoints"):
                         code, payload = dispatcher._savepoint(parts[1])
@@ -241,15 +263,34 @@ class ClusterClient:
     def _url(self, path: str) -> str:
         return f"http://{self.address}{path}"
 
+    @staticmethod
+    def _raise_with_server_error(e) -> None:
+        """Surface the dispatcher's JSON error detail instead of the bare
+        'HTTP Error 500' urllib message."""
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except (ValueError, OSError):
+            detail = ""
+        raise RuntimeError(
+            f"dispatcher returned {e.code}: {detail or e.reason}") from e
+
     def _get(self, path: str) -> dict:
-        with urllib.request.urlopen(self._url(path), timeout=30) as r:
-            return json.loads(r.read().decode())
+        import urllib.error
+        try:
+            with urllib.request.urlopen(self._url(path), timeout=30) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            self._raise_with_server_error(e)
 
     def _post(self, path: str, body: bytes = b"") -> dict:
+        import urllib.error
         req = urllib.request.Request(self._url(path), data=body,
                                      method="POST")
-        with urllib.request.urlopen(req, timeout=60) as r:
-            return json.loads(r.read().decode())
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            self._raise_with_server_error(e)
 
     def submit(self, env_or_graph, config=None, name: str = "job",
                restore=None) -> str:

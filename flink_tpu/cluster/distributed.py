@@ -466,6 +466,11 @@ class DistributedHost:
         (resource_manager.build_schedule — a 2-slot host takes twice the
         subtasks of a 1-slot host)."""
         jg, config = self.jg, self.config
+        if any(e.feedback for e in jg.edges):
+            raise NotImplementedError(
+                "iterations (feedback edges) run on the local deployment "
+                "only; the distributed SPMD deploy does not wire back "
+                "edges yet")
         job = LocalJob(jg, config)
         aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
         live = live_hosts or list(range(self.n_hosts))

@@ -128,6 +128,16 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     unaligned = config.get(CheckpointingOptions.UNALIGNED)
     alignment_timeout = config.get(CheckpointingOptions.ALIGNMENT_TIMEOUT)
 
+    has_feedback = any(e.feedback for e in job_graph.edges)
+    if has_feedback and config.get(CheckpointingOptions.INTERVAL) > 0:
+        # a barrier circulating a feedback loop would re-align the head
+        # forever; the reference's iterations likewise exclude loop state
+        # from exactly-once guarantees — reject loudly instead of hanging
+        raise ValueError(
+            "iterations (feedback edges) cannot run with periodic "
+            "checkpointing enabled; disable execution.checkpointing."
+            "interval for this job")
+
     for vid, vertex in job_graph.vertices.items():
         out_edges = [(ei, e) for ei, e in enumerate(job_graph.edges)
                      if e.source_vertex == vid]
@@ -144,13 +154,15 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 config=config, metrics=metrics, operator_id=vertex.id,
                 kv_registry=job.kv_registry)
 
-            # writers: one per (non-side) out edge; side writers by tag
+            # writers: one per (non-side) out edge; side writers by tag;
+            # feedback edges get the filtering writer (records only)
+            from ..runtime.writer import FeedbackRecordWriter
             writers, side_writers = [], {}
             for ei, e in out_edges:
-                w = RecordWriter(
-                    [channels[ei][sub][d]
-                     for d in range(len(channels[ei][sub]))],
-                    e.partitioner_factory(), sub)
+                cls = FeedbackRecordWriter if e.feedback else RecordWriter
+                w = cls([channels[ei][sub][d]
+                         for d in range(len(channels[ei][sub]))],
+                        e.partitioner_factory(), sub)
                 if e.side_tag is None:
                     writers.append(w)
                 else:
@@ -202,13 +214,22 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                     task.restore_state(snapshot)
             else:
                 # input gate over all in-edges' channels for this subtask
-                in_channels = []
+                in_channels, feedback_idx = [], set()
                 for ei, e in in_edges:
                     for s in range(len(channels[ei])):
+                        if e.feedback:
+                            feedback_idx.add(len(in_channels))
                         in_channels.append(channels[ei][s][sub])
-                gate = InputGate(in_channels, aligned=aligned,
-                                 unaligned=unaligned and aligned,
-                                 alignment_timeout_s=alignment_timeout)
+                head_node = vertex.chained_nodes[0]
+                if getattr(head_node, "iteration_head", False):
+                    from ..runtime.channels import IterationGate
+                    gate = IterationGate(
+                        in_channels, feedback_idx,
+                        head_node.iteration_wait_s, aligned=aligned)
+                else:
+                    gate = InputGate(in_channels, aligned=aligned,
+                                     unaligned=unaligned and aligned,
+                                     alignment_timeout_s=alignment_timeout)
                 ops = [n.operator_factory() for n in vertex.chained_nodes]
                 task = OneInputStreamTask.__new__(OneInputStreamTask)
                 StreamTask.__init__(task, task_id, ctx, writers, job, config,

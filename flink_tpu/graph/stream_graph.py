@@ -21,9 +21,9 @@ from typing import Any, Callable, Optional
 
 from ..core.config import Configuration, PipelineOptions
 from .transformations import (
-    OneInputTransformation, PartitionTransformation, SideOutputTransformation,
-    SinkTransformation, SourceTransformation, Transformation,
-    TwoInputTransformation, UnionTransformation,
+    FeedbackTransformation, OneInputTransformation, PartitionTransformation,
+    SideOutputTransformation, SinkTransformation, SourceTransformation,
+    Transformation, TwoInputTransformation, UnionTransformation,
 )
 
 __all__ = ["StreamNode", "StreamEdge", "StreamGraph", "JobVertex", "JobEdge",
@@ -47,6 +47,10 @@ class StreamNode:
     source: Any = None
     watermark_strategy: Any = None
     traceable: bool = False
+    # iteration head (FeedbackTransformation): its gate terminates after
+    # regular inputs end + the feedback loop stays quiet for this long
+    iteration_head: bool = False
+    iteration_wait_s: float = 0.0
 
 
 @dataclass
@@ -57,6 +61,7 @@ class StreamEdge:
     partitioner_name: str = "forward"
     side_tag: Optional[str] = None
     target_input: int = 0  # 0/1 for two-input operators
+    feedback: bool = False  # iteration back edge (body tail -> head)
 
 
 @dataclass
@@ -141,6 +146,20 @@ def build_stream_graph(sinks: list[Transformation],
                               operator_factory=t.operator_factory,
                               key_extractor=t.key_extractor1,
                               key_extractor2=t.key_extractor2)
+        elif isinstance(t, FeedbackTransformation):
+            from ..runtime.operators.simple import BatchFnOperator
+            node = StreamNode(t.id, t.name, "one_input", par, maxp,
+                              uid=t.effective_uid,
+                              uid_explicit=t.uid is not None,
+                              # the head owns a special gate: never fuse it
+                              # into an upstream chain (a source task has
+                              # no gate to attach the feedback channel to)
+                              chaining_allowed=False,
+                              slot_sharing_group=t.slot_sharing_group,
+                              operator_factory=lambda: BatchFnOperator(
+                                  lambda b: b, "IterationHead"),
+                              iteration_head=True,
+                              iteration_wait_s=t.max_wait_s)
         elif isinstance(t, OneInputTransformation):
             node = StreamNode(t.id, t.name, "one_input", par, maxp,
                               uid=t.effective_uid,
@@ -153,6 +172,8 @@ def build_stream_graph(sinks: list[Transformation],
         else:
             raise TypeError(f"Unknown transformation {type(t)}")
         g.nodes[node.id] = node
+        # register BEFORE resolving inputs: a feedback edge cycles back to
+        # this node, and the visited entry is what breaks the recursion
         visited[t.id] = node.id
 
         if isinstance(t, TwoInputTransformation):
@@ -163,6 +184,16 @@ def build_stream_graph(sinks: list[Transformation],
             for up in t.inputs:
                 for nid, attrs in resolve_input(up):
                     g.edges.append(_make_edge(nid, node.id, attrs, 0))
+        if isinstance(t, FeedbackTransformation):
+            if not t.feedback_inputs:
+                raise ValueError(
+                    f"iteration {t.name!r} was never closed: call "
+                    "close_with(feedback_stream) on the IterativeStream")
+            for up in t.feedback_inputs:
+                for nid, attrs in resolve_input(up):
+                    a = dict(attrs)
+                    a["feedback"] = True
+                    g.edges.append(_make_edge(nid, node.id, a, 0))
         return node.id
 
     for s in sinks:
@@ -179,7 +210,8 @@ def _make_edge(source_id: int, target_id: int, attrs: dict,
                                       ForwardPartitioner),
         partitioner_name=attrs.get("partitioner_name", "forward"),
         side_tag=attrs.get("side_tag"),
-        target_input=target_input)
+        target_input=target_input,
+        feedback=attrs.get("feedback", False))
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +226,7 @@ class JobEdge:
     partitioner_name: str = "forward"
     side_tag: Optional[str] = None
     target_input: int = 0
+    feedback: bool = False
 
 
 @dataclass
@@ -254,7 +287,7 @@ def build_job_graph(g: StreamGraph, config: Configuration,
     chaining = config.get(PipelineOptions.CHAINING_ENABLED)
 
     def chainable(e: StreamEdge) -> bool:
-        if not chaining or e.side_tag is not None:
+        if not chaining or e.side_tag is not None or e.feedback:
             return False
         up, down = g.nodes[e.source_id], g.nodes[e.target_id]
         return (e.partitioner_name == "forward"
@@ -322,5 +355,6 @@ def build_job_graph(g: StreamGraph, config: Configuration,
             source_vertex=f"v{src_head}", target_vertex=f"v{dst_head}",
             partitioner_factory=e.partitioner_factory,
             partitioner_name=e.partitioner_name,
-            side_tag=e.side_tag, target_input=e.target_input))
+            side_tag=e.side_tag, target_input=e.target_input,
+            feedback=e.feedback))
     return jg

@@ -89,3 +89,16 @@ class SinkTransformation(Transformation):
 @dataclass
 class SideOutputTransformation(Transformation):
     tag: str = ""
+
+
+@dataclass
+class FeedbackTransformation(Transformation):
+    """Iteration head (reference FeedbackTransformation +
+    StreamIterationHead/Tail): a pass-through node whose input set grows a
+    FEEDBACK edge at close_with time — records emitted by the loop body
+    flow back into this node. The head terminates after its regular inputs
+    finish AND the feedback loop has been quiet for ``max_wait_s``
+    (reference iteration-head await timeout)."""
+
+    feedback_inputs: list["Transformation"] = field(default_factory=list)
+    max_wait_s: float = 2.0

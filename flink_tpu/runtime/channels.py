@@ -24,7 +24,8 @@ from ..core.elements import (
 )
 from ..core.records import MIN_TIMESTAMP, RecordBatch
 
-__all__ = ["Channel", "LocalChannel", "InputGate", "GateEvent"]
+__all__ = ["Channel", "LocalChannel", "InputGate", "IterationGate",
+           "GateEvent"]
 
 DEFAULT_CAPACITY = 64  # queued elements per channel before backpressure
 
@@ -288,3 +289,46 @@ class InputGate:
             self.unblock_all()
             return GateEvent("barrier", b)
         return None
+
+
+class IterationGate(InputGate):
+    """Gate for an iteration head (reference StreamIterationHead): some
+    channels are FEEDBACK edges from the loop body. Termination cannot wait
+    for their EndOfInput — the body only ends after the head does — so the
+    head ends once every regular channel ended AND the loop has been quiet
+    (no event polled, no feedback data queued) for ``max_wait_s``. Feedback
+    channels start inactive so the loop's (filtered-out) watermarks never
+    hold back event time; only record batches flow on them."""
+
+    def __init__(self, channels: list[Channel], feedback: set[int],
+                 max_wait_s: float, **kwargs):
+        super().__init__(channels, **kwargs)
+        self.feedback = set(feedback)
+        self.max_wait_s = max_wait_s
+        self._quiet_since: Optional[float] = None
+        for i in self.feedback:
+            self._active[i] = False
+
+    def poll(self) -> Optional[GateEvent]:
+        ev = super().poll()
+        if ev is not None:
+            self._quiet_since = None     # any activity resets quiescence
+        return ev
+
+    def all_ended(self) -> bool:
+        regular = [i for i in range(len(self.channels))
+                   if i not in self.feedback]
+        if not all(self._ended[i] for i in regular):
+            self._quiet_since = None
+            return False
+        if all(self._ended):
+            return True
+        if any(self.channels[i].size() > 0 for i in self.feedback
+               if not self._ended[i]):
+            self._quiet_since = None     # queued feedback: not quiet
+            return False
+        now = time.time()
+        if self._quiet_since is None:
+            self._quiet_since = now
+            return False
+        return now - self._quiet_since >= self.max_wait_s

@@ -190,3 +190,18 @@ class RecordWriter:
 
     def emit_end(self) -> None:
         self.broadcast(EndOfInput())
+
+
+class FeedbackRecordWriter(RecordWriter):
+    """Writer for an iteration back edge: only RECORDS and EndOfInput flow
+    into the loop (reference StreamIterationTail). Watermarks and barriers
+    are dropped — event time does not advance through feedback (the head's
+    gate keeps feedback channels idle), and a barrier circulating the loop
+    would re-trigger the head's alignment (iterations are therefore not
+    checkpointable; deploy rejects the combination loudly)."""
+
+    def broadcast(self, element) -> None:
+        if isinstance(element, EndOfInput):
+            super().broadcast(element)
+        # Watermark / WatermarkStatus / CheckpointBarrier / LatencyMarker:
+        # intentionally dropped on the back edge

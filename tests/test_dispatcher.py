@@ -190,3 +190,64 @@ def test_env_execute_routes_to_remote_target(tmp_path):
             assert len(f.readlines()) == 500
     finally:
         d.stop()
+
+
+def test_cancel_terminal_job_conflicts_and_keeps_state(tmp_path):
+    d = Dispatcher(port=0)
+    d.start()
+    try:
+        client = ClusterClient(d.address)
+        env = _build_env(str(tmp_path / "t.csv"), n=100)
+        job_id = client.submit(env)
+        assert client.wait(job_id, timeout=60.0)["state"] == "FINISHED"
+        with pytest.raises(RuntimeError, match="409"):
+            client.cancel(job_id)
+        assert client.status(job_id)["state"] == "FINISHED"  # state kept
+    finally:
+        d.stop()
+
+
+def test_cancel_before_drive_thread_runs(tmp_path):
+    """A cancel landing before the job thread is scheduled must win: the
+    job never runs and stays CANCELLED."""
+    d = Dispatcher(port=0)
+    try:
+        sink = str(tmp_path / "never.csv")
+        env = _build_env(sink, n=100_000, rate=1000.0)
+        jg = env.get_job_graph("race")
+        # submit directly (no HTTP) and cancel in the same instant
+        job_id = d.submit(jg, env.config)
+        d.cancel(job_id)
+        run = d._jobs[job_id]
+        run.thread.join(10.0)
+        assert run.state == "CANCELLED"
+        import os
+        # the job may have started before cancel; but if cancel won the
+        # race, nothing was written. Either way the final state holds.
+        assert d.status(job_id)["state"] == "CANCELLED"
+    finally:
+        d.stop()
+
+
+def test_savepoint_on_iteration_job_refused():
+    import numpy as np
+
+    from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.records import Schema
+
+    schema = Schema([("v", np.int64)])
+    env = StreamExecutionEnvironment()
+    ds = env.from_collection([(4,), (9,)], schema, timestamps=[0, 0])
+    it = ds.iterate(max_wait_s=0.5)
+    body = it.filter(lambda r: False, name="drop")
+    it.close_with(body)
+    sink = CollectSink()
+    it.filter(lambda r: True, name="keep").add_sink(sink, "s")
+    job = env.execute_async("loop-sp")
+    try:
+        coord = CheckpointCoordinator(job, env.config)
+        with pytest.raises(ValueError, match="feedback"):
+            coord.trigger_savepoint(timeout=5.0)
+    finally:
+        job.cancel()
