@@ -210,9 +210,13 @@ class DataStream:
         stream, then ``close_with(feedback_stream)`` to route records back
         into the head. The head terminates once this stream's regular
         input finished and the loop stayed quiet for ``max_wait_s``.
-        Iterations are not checkpointable (deploy rejects the combination
-        with periodic checkpointing, matching the reference's exclusion of
-        loop state from exactly-once guarantees)."""
+        ``max_wait_s`` must exceed the body's worst-case per-batch latency
+        — records still being processed inside the body when the window
+        expires are lost (the reference iteration head has the same
+        timeout semantics). Iterations are not checkpointable (deploy
+        rejects the combination with periodic checkpointing, matching the
+        reference's exclusion of loop state from exactly-once
+        guarantees)."""
         from ..graph.transformations import FeedbackTransformation
         t = FeedbackTransformation(name="iteration",
                                    inputs=[self.transformation],

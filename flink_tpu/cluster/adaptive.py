@@ -1,0 +1,196 @@
+"""Adaptive scheduler: explicit state machine + reactive rescaling.
+
+Reference: scheduler/adaptive/AdaptiveScheduler.java:167 with one class per
+state (Created, WaitingForResources, Executing, Restarting, Finished,
+Failing) and REACTIVE mode — the job's parallelism tracks the resources
+that are actually available: workers joining scale the job up, workers
+leaving scale it down, always through stop-with-savepoint -> redeploy so
+keyed state re-shards by key-group range.
+
+TPU-native shape: "resources" are the SlotManager's usable slot count
+(cluster/resource_manager.py — registrations minus blocklist). Desired
+parallelism for every scalable vertex = min(total_slots, vertex
+max_parallelism), floored at min_parallelism. The state machine drives the
+same JobSupervisor rescale primitive the operator would call by hand, and
+every transition lands in ``history`` for observability/tests (reference
+exposes the same through the REST jobs/:id/status).
+
+States and transitions:
+
+    CREATED -> WAITING_FOR_RESOURCES      start()
+    WAITING_FOR_RESOURCES -> EXECUTING    enough slots (>= min_parallelism)
+    EXECUTING -> RESTARTING               resource change => new parallelism
+    RESTARTING -> EXECUTING               redeploy from savepoint done
+    EXECUTING -> FINISHED | FAILED        job terminal
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..core.config import Configuration
+from .resource_manager import SlotManager
+from .scheduler import JobSupervisor
+
+__all__ = ["AdaptiveScheduler"]
+
+_SCALABLE_KINDS = ("one_input",)   # sources/sinks keep their parallelism
+
+
+class AdaptiveScheduler:
+    """Runs a JobGraph with parallelism tracking available slots."""
+
+    STATES = ("CREATED", "WAITING_FOR_RESOURCES", "EXECUTING", "RESTARTING",
+              "FINISHED", "FAILED")
+
+    def __init__(self, job_graph, config: Configuration,
+                 slots: Optional[SlotManager] = None,
+                 min_parallelism: int = 1,
+                 resource_stabilization_s: float = 0.05,
+                 scale_check_interval_s: float = 0.05):
+        self.job_graph = job_graph
+        self.config = config
+        self.slots = slots or SlotManager()
+        self.min_parallelism = min_parallelism
+        self.stabilization_s = resource_stabilization_s
+        self.check_interval_s = scale_check_interval_s
+        self.state = "CREATED"
+        self.history: list[tuple[str, str]] = []   # (state, reason)
+        self.supervisor: Optional[JobSupervisor] = None
+        self.current_parallelism = 0
+        self.rescales = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._run_error: Optional[BaseException] = None
+        self._terminal = threading.Event()
+
+    # -- state machine -----------------------------------------------------
+    def _transition(self, to: str, reason: str) -> None:
+        assert to in self.STATES, to
+        self.state = to
+        self.history.append((to, reason))
+        if to in ("FINISHED", "FAILED"):
+            self._terminal.set()
+
+    def _desired_parallelism(self) -> int:
+        total = self.slots.total_slots()
+        maxp = min((v.max_parallelism
+                    for v in self.job_graph.vertices.values()),
+                   default=128)
+        return max(0, min(total, maxp))
+
+    def _scalable_vertices(self) -> list[str]:
+        return [vid for vid, v in self.job_graph.vertices.items()
+                if v.kind in _SCALABLE_KINDS]
+
+    def _apply_parallelism(self, par: int) -> None:
+        for vid in self._scalable_vertices():
+            self.job_graph.vertices[vid].parallelism = par
+        self.current_parallelism = par
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Begin scheduling; returns immediately (drive() runs on its own
+        thread, reference's main-thread executor collapsed onto it)."""
+        self._transition("WAITING_FOR_RESOURCES", "started")
+        self._thread = threading.Thread(target=self._drive, daemon=True,
+                                        name="adaptive-scheduler")
+        self._thread.start()
+
+    def wait_terminal(self, timeout: float = 120.0) -> str:
+        if not self._terminal.wait(timeout):
+            raise TimeoutError(f"not terminal within {timeout}s "
+                               f"(state={self.state})")
+        if self.state == "FAILED" and self._run_error is not None:
+            raise RuntimeError("adaptive job failed") from self._run_error
+        return self.state
+
+    def stop(self) -> None:
+        self._stop.set()
+        sup = self.supervisor
+        if sup is not None and sup.current_job is not None:
+            sup.current_job.cancel()
+        if self._thread is not None:
+            self._thread.join(5.0)
+
+    # -- driver ------------------------------------------------------------
+    def _wait_for_resources(self) -> Optional[int]:
+        """Block until >= min_parallelism slots exist AND the slot count
+        has been stable for the stabilization window (reference
+        WaitingForResources stabilization timeout)."""
+        stable_since, last = None, -1
+        while not self._stop.is_set():
+            par = self._desired_parallelism()
+            if par >= self.min_parallelism:
+                if par != last:
+                    stable_since, last = time.time(), par
+                elif time.time() - stable_since >= self.stabilization_s:
+                    return par
+            else:
+                stable_since, last = None, -1
+            time.sleep(self.check_interval_s / 2)
+        return None
+
+    def _drive(self) -> None:
+        par = self._wait_for_resources()
+        if par is None:
+            return
+        self._apply_parallelism(par)
+        self.supervisor = JobSupervisor(self.job_graph, self.config)
+        self._transition("EXECUTING", f"deployed at parallelism {par}")
+
+        result: dict = {}
+
+        def run_job():
+            try:
+                result["job"] = self.supervisor.run(timeout=None)
+            except BaseException as e:  # noqa: BLE001 - drives FAILED state
+                result["error"] = e
+
+        runner = threading.Thread(target=run_job, daemon=True,
+                                  name="adaptive-job")
+        runner.start()
+
+        while not self._stop.is_set():
+            runner.join(self.check_interval_s)
+            if not runner.is_alive():
+                break
+            desired = self._desired_parallelism()
+            if (desired != self.current_parallelism
+                    and desired >= self.min_parallelism
+                    and self.state == "EXECUTING"):
+                # stabilization: don't thrash on a worker mid-restart
+                time.sleep(self.stabilization_s)
+                settled = self._desired_parallelism()
+                if settled == self.current_parallelism \
+                        or settled < self.min_parallelism:
+                    continue
+                self._transition(
+                    "RESTARTING",
+                    f"resources changed: {self.current_parallelism} "
+                    f"-> {settled}")
+                try:
+                    self.supervisor.rescale(
+                        {vid: settled for vid in self._scalable_vertices()})
+                    self.current_parallelism = settled
+                    self.rescales += 1
+                    self._transition(
+                        "EXECUTING", f"rescaled to parallelism {settled}")
+                except Exception as e:  # noqa: BLE001 - job may have just
+                    if not runner.is_alive():   # finished under us: fine
+                        break
+                    self._run_error = e
+                    self._transition("FAILED", f"rescale failed: {e}")
+                    return
+        runner.join(5.0)
+        if self._stop.is_set():
+            # stopped externally: the cancelled attempt's clean unwind must
+            # not read as a successful FINISHED — state stays as-is
+            return
+        if "error" in result:
+            self._run_error = result["error"]
+            self._transition("FAILED", str(result["error"]))
+        else:
+            self._transition("FINISHED", "job completed")

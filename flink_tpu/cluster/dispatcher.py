@@ -131,6 +131,9 @@ class Dispatcher:
             return False
         sup = run.supervisor
         if sup is not None:
+            # the flag closes the deploy window (current_job not yet
+            # assigned): the supervisor checks it right after deploying
+            sup.cancel_requested = True
             # stop the supervisor's restart loop from resurrecting it
             sup.restart_strategy = _NeverRestart()
             if sup.coordinator is not None:

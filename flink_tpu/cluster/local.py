@@ -116,13 +116,19 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     job = LocalJob(job_graph, config)
     job.metrics_registry = metrics_registry
 
-    # channels[edge_key][src_sub][dst_sub]
+    # channels[edge_key][src_sub][dst_sub]; feedback channels are UNBOUNDED:
+    # a bounded back edge would wedge the body forever once the head exits
+    # on quiescence (nothing drains a dead loop), and a live loop blocking
+    # on its own output is the classic iteration deadlock the reference
+    # documents — growth under a slow head is the accepted tradeoff
     channels: dict[int, list[list[LocalChannel]]] = {}
     for ei, e in enumerate(job_graph.edges):
         src = job_graph.vertices[e.source_vertex]
         dst = job_graph.vertices[e.target_vertex]
-        channels[ei] = [[LocalChannel() for _ in range(dst.parallelism)]
-                        for _ in range(src.parallelism)]
+        channels[ei] = [
+            [LocalChannel(0) if e.feedback else LocalChannel()  # 0=unbounded
+             for _ in range(dst.parallelism)]
+            for _ in range(src.parallelism)]
 
     aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
     unaligned = config.get(CheckpointingOptions.UNALIGNED)

@@ -40,6 +40,10 @@ class JobSupervisor:
         self.metrics_registry = metrics_registry
         self.restart_strategy = restart_strategy_from_config(config)
         self.attempt = 0
+        # external cancel intent (dispatcher/HA): checked right after each
+        # deploy, so a cancel landing in the deploy window — before
+        # current_job exists to cancel — still stops the job
+        self.cancel_requested = False
         self.current_job: Optional[LocalJob] = None
         self.coordinator: Optional[CheckpointCoordinator] = None
         self._latest: Optional[CompletedCheckpoint] = None
@@ -73,8 +77,14 @@ class JobSupervisor:
         if initial_restore is not None:
             self._latest = initial_restore
         while True:
+            if self.cancel_requested:
+                return self.current_job
             self.attempt += 1
             job = self._deploy(restore)
+            if self.cancel_requested:
+                self.coordinator.stop()
+                job.cancel()
+                return job
             job.start()
             try:
                 while True:

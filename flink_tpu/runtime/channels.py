@@ -306,6 +306,8 @@ class IterationGate(InputGate):
         self.feedback = set(feedback)
         self.max_wait_s = max_wait_s
         self._quiet_since: Optional[float] = None
+        self._regular = [i for i in range(len(channels))
+                         if i not in self.feedback]
         for i in self.feedback:
             self._active[i] = False
 
@@ -316,9 +318,7 @@ class IterationGate(InputGate):
         return ev
 
     def all_ended(self) -> bool:
-        regular = [i for i in range(len(self.channels))
-                   if i not in self.feedback]
-        if not all(self._ended[i] for i in regular):
+        if not all(self._ended[i] for i in self._regular):
             self._quiet_since = None
             return False
         if all(self._ended):
