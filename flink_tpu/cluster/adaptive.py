@@ -107,11 +107,22 @@ class AdaptiveScheduler:
             raise RuntimeError("adaptive job failed") from self._run_error
         return self.state
 
+    def _cancel_supervised(self) -> None:
+        """Stop the supervised job for good: the cancel_requested flag
+        also covers the rescale/redeploy window where current_job is not
+        yet the attempt that would otherwise survive."""
+        sup = self.supervisor
+        if sup is None:
+            return
+        sup.cancel_requested = True
+        if sup.coordinator is not None:
+            sup.coordinator.stop()
+        if sup.current_job is not None:
+            sup.current_job.cancel()
+
     def stop(self) -> None:
         self._stop.set()
-        sup = self.supervisor
-        if sup is not None and sup.current_job is not None:
-            sup.current_job.cancel()
+        self._cancel_supervised()
         if self._thread is not None:
             self._thread.join(5.0)
 
@@ -178,10 +189,21 @@ class AdaptiveScheduler:
                     self.rescales += 1
                     self._transition(
                         "EXECUTING", f"rescaled to parallelism {settled}")
-                except Exception as e:  # noqa: BLE001 - job may have just
-                    if not runner.is_alive():   # finished under us: fine
+                except Exception as e:  # noqa: BLE001 - drives FAILED state
+                    # the rescale may have raced a NATURAL completion (the
+                    # savepoint found finished tasks): only that counts as
+                    # fine — a job cancelled mid-rescale must not read as
+                    # FINISHED, and a still-running job must not keep
+                    # producing after we report FAILED
+                    runner.join(2.0)
+                    job = self.supervisor.current_job
+                    completed = (not runner.is_alive() and job is not None
+                                 and not job.failed
+                                 and len(job._finished) == len(job.tasks))
+                    if completed:
                         break
                     self._run_error = e
+                    self._cancel_supervised()
                     self._transition("FAILED", f"rescale failed: {e}")
                     return
         runner.join(5.0)

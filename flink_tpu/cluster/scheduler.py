@@ -141,7 +141,15 @@ class JobSupervisor:
             for vid, par in vertex_parallelism.items():
                 self.job_graph.vertices[vid].parallelism = par
             self._latest = sp
+            if self.cancel_requested:
+                # a cancel landed mid-rescale: redeploying would resurrect
+                # the job the caller just stopped
+                return
             job = self._deploy(sp)
+            if self.cancel_requested:
+                self.coordinator.stop()
+                job.cancel()
+                return
             job.start()
         finally:
             self._rescaling = False

@@ -1,4 +1,4 @@
-"""File source/sink with exactly-once commit.
+"""File source/sink with exactly-once commit, over the FileSystem SPI.
 
 Analogs of the reference's flink-connector-files:
 * FileSource (FLIP-27: one split per file with a byte/line offset so
@@ -11,19 +11,30 @@ Analogs of the reference's flink-connector-files:
   renames pending files to visible part files (commit). Uncommitted temp
   files from a crashed attempt are ignored by readers and cleaned on
   restart.
+
+Paths resolve through core/fs.py (reference core/fs/FileSystem.java), so
+``mem://`` object-store-style paths work everywhere local paths do —
+including SQL filesystem tables — and new schemes arrive as plugins.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import glob as _glob
+import io
 import os
 from typing import Any, Optional
 
+from ..core.fs import get_file_system
 from ..core.records import RecordBatch, Schema
 from ..formats.core import Format
 from .core import Sink, SinkWriter, Source, SourceReader, SourceSplit
 
 __all__ = ["FileSource", "FileSink"]
+
+
+def _join(base: str, name: str) -> str:
+    return base.rstrip("/") + "/" + name
 
 
 class FileSource(Source):
@@ -39,14 +50,18 @@ class FileSource(Source):
         self._batch_lines = batch_lines
 
     def _files(self) -> list[str]:
-        if os.path.isdir(self._path):
-            names = sorted(
-                os.path.join(self._path, n) for n in os.listdir(self._path)
-                if not n.startswith(".") and not n.endswith(".inprogress"))
-            return [n for n in names if os.path.isfile(n)]
-        matches = sorted(_glob.glob(self._path))
-        if matches:
-            return matches
+        fs, p = get_file_system(self._path)
+        if fs.is_dir(p):
+            return [
+                _join(self._path, n) for n in fs.listdir(p)
+                if not n.startswith(".") and not n.endswith(".inprogress")
+                and not fs.is_dir(_join(p, n))]
+        if "://" not in self._path:
+            matches = sorted(_glob.glob(self._path))
+            if matches:
+                return matches
+        elif fs.exists(p):
+            return [self._path]
         raise FileNotFoundError(self._path)
 
     def create_splits(self, parallelism: int) -> list[SourceSplit]:
@@ -86,7 +101,8 @@ class _FileReader(SourceReader):
         """Reads by byte offset (seek + readline) so resuming and batching
         stay O(batch), not O(file)."""
         at_start = self._pos == 0
-        with open(path, "rb") as f:
+        fs, p = get_file_system(path)
+        with fs.open_read(p) as f:
             f.seek(self._pos)
             lines = []
             for _ in range(self._batch):
@@ -102,7 +118,8 @@ class _FileReader(SourceReader):
         return self._fmt.decode_lines(lines)
 
     def _read_binary(self, path: str) -> Optional[RecordBatch]:
-        with open(path, "rb") as f:
+        fs, p = get_file_system(path)
+        with fs.open_read(p) as f:
             f.seek(self._pos)
             data = self._pending + f.read(1 << 20)
             if not data:
@@ -133,7 +150,8 @@ class FileSink(Sink):
         self._prefix = part_prefix
 
     def create_writer(self, subtask_index: int) -> SinkWriter:
-        os.makedirs(self._dir, exist_ok=True)
+        fs, p = get_file_system(self._dir)
+        fs.makedirs(p)
         return _FileWriter(self._dir, self._fmt, subtask_index,
                            self._rolling_size, self._prefix)
 
@@ -142,6 +160,7 @@ class _FileWriter(SinkWriter):
     def __init__(self, directory: str, fmt: Format, subtask: int,
                  rolling_size: int, prefix: str):
         self._dir = directory
+        self._fs, self._dir_path = get_file_system(directory)
         self._fmt = fmt
         self._subtask = subtask
         self._rolling = rolling_size
@@ -149,7 +168,7 @@ class _FileWriter(SinkWriter):
         self._seq = 0
         self._fh = None
         self._in_progress: Optional[str] = None
-        # pending[checkpoint_id] -> [(tmp_path, final_path)]
+        # pending[checkpoint_id] -> [(tmp_path, final_path)]  (fs-relative)
         self._pending: dict[int, list[tuple[str, str]]] = {}
         self._cleaned = False
 
@@ -161,12 +180,18 @@ class _FileWriter(SinkWriter):
         self._cleaned = True
         keep = {tmp for entries in self._pending.values()
                 for tmp, _ in entries}
-        pat = os.path.join(self._dir,
-                           f".{self._prefix}-{self._subtask}-*.inprogress")
-        for stale in _glob.glob(pat):
+        pat = f".{self._prefix}-{self._subtask}-*.inprogress"
+        try:
+            names = self._fs.listdir(self._dir_path)
+        except OSError:
+            return
+        for name in names:
+            if not fnmatch.fnmatch(name, pat):
+                continue
+            stale = _join(self._dir_path, name)
             if stale not in keep:
                 try:
-                    os.remove(stale)
+                    self._fs.remove(stale)
                 except OSError:
                     pass
 
@@ -174,10 +199,9 @@ class _FileWriter(SinkWriter):
         if not self._cleaned:
             self._clean_stale()
         final = f"{self._prefix}-{self._subtask}-{self._seq}"
-        self._in_progress = os.path.join(self._dir, f".{final}.inprogress")
-        self._final = os.path.join(self._dir, final)
-        mode = "ab" if self._fmt.binary else "a"
-        self._fh = open(self._in_progress, mode)
+        self._in_progress = _join(self._dir_path, f".{final}.inprogress")
+        self._final = _join(self._dir_path, final)
+        self._fh = self._fs.open_write(self._in_progress, append=True)
         self._seq += 1
 
     def write_batch(self, batch: RecordBatch) -> None:
@@ -188,7 +212,7 @@ class _FileWriter(SinkWriter):
         if self._fmt.binary:
             self._fh.write(self._fmt.encode_block(batch))
         else:
-            self._fh.write(self._fmt.encode_batch(batch))
+            self._fh.write(self._fmt.encode_batch(batch).encode("utf-8"))
         if self._fh.tell() >= self._rolling:
             self._roll_pending_file(checkpoint_id=None)
 
@@ -207,7 +231,11 @@ class _FileWriter(SinkWriter):
     def flush(self) -> None:
         if self._fh is not None:
             self._fh.flush()
-            os.fsync(self._fh.fileno())
+            try:
+                os.fsync(self._fh.fileno())
+            except io.UnsupportedOperation:
+                pass  # memory-backed streams have no fd to sync; a REAL
+                # fsync failure (EIO) must still fail the checkpoint
 
     def prepare_commit(self, checkpoint_id: int) -> None:
         self._roll_pending_file(checkpoint_id)
@@ -222,8 +250,8 @@ class _FileWriter(SinkWriter):
         for cid in sorted(k for k in self._pending
                           if 0 <= k <= checkpoint_id):
             for tmp, final in self._pending.pop(cid):
-                if os.path.exists(tmp):
-                    os.replace(tmp, final)  # atomic, idempotent on redo
+                if self._fs.exists(tmp):
+                    self._fs.rename(tmp, final)  # atomic, idempotent on redo
         # recovery redelivery: a committed tmp no longer exists -> no-op
 
     def snapshot(self) -> Any:
@@ -238,8 +266,8 @@ class _FileWriter(SinkWriter):
         # FileSink committer recovery)
         for cid, entries in state.get("pending", {}).items():
             for tmp, final in entries:
-                if os.path.exists(tmp):
-                    os.replace(tmp, final)
+                if self._fs.exists(tmp):
+                    self._fs.rename(tmp, final)
 
     def close(self) -> None:
         if self._fh is not None:
