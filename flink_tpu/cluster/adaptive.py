@@ -196,9 +196,11 @@ class AdaptiveScheduler:
                     # FINISHED, and a still-running job must not keep
                     # producing after we report FAILED
                     runner.join(2.0)
+                    if self._stop.is_set():
+                        return  # user stop mid-rescale is not a failure
                     job = self.supervisor.current_job
                     completed = (not runner.is_alive() and job is not None
-                                 and not job.failed
+                                 and not job.failed and not job.cancelled
                                  and len(job._finished) == len(job.tasks))
                     if completed:
                         break

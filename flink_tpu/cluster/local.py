@@ -45,6 +45,9 @@ class LocalJob(TaskReporter):
         self.source_tasks: dict[str, SourceStreamTask] = {}
         self._finished: set[str] = set()
         self._failed: list[tuple[str, BaseException]] = []
+        # a cancelled job's tasks unwind cleanly through task_finished;
+        # this flag is how callers tell cancellation from real completion
+        self.cancelled = False
         self._lock = threading.Lock()
         self._done = threading.Event()
         self.checkpoint_listener: Optional[Callable] = None  # coordinator hook
@@ -87,6 +90,7 @@ class LocalJob(TaskReporter):
             t.start()
 
     def cancel(self) -> None:
+        self.cancelled = True
         for t in self.tasks.values():
             t.cancel()
         self._done.set()

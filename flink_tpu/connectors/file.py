@@ -60,8 +60,20 @@ class FileSource(Source):
             matches = sorted(_glob.glob(self._path))
             if matches:
                 return matches
-        elif fs.exists(p):
+        elif fs.exists(p) and not any(c in p for c in "*?["):
             return [self._path]
+        else:
+            # scheme glob: match the last segment against the parent's
+            # listing (object stores have no native glob)
+            parent, _, pattern = p.rpartition("/")
+            scheme = self._path.split("://", 1)[0]
+            if parent and fs.is_dir(parent):
+                matches = sorted(
+                    f"{scheme}://{parent}/{n}" for n in fs.listdir(parent)
+                    if fnmatch.fnmatch(n, pattern)
+                    and not fs.is_dir(_join(parent, n)))
+                if matches:
+                    return matches
         raise FileNotFoundError(self._path)
 
     def create_splits(self, parallelism: int) -> list[SourceSplit]:

@@ -45,12 +45,19 @@ class PluginRegistry:
         from ..state.backend import register_backend
         register_backend(name, cls)
 
-    def connector(self, name: str, factory: Callable) -> None:
-        """SQL connector factory: factory(env, catalog_table) -> DataStream
-        for sources; looked up by the DDL layer after built-ins."""
-        self.connectors[name] = factory
+    def connector(self, name: str, source: Callable = None,
+                  sink: Callable = None) -> None:
+        """SQL connector: ``source(env, catalog_table) -> DataStream``,
+        ``sink(catalog_table) -> Sink|SinkFunction``. The DDL layer
+        consults plugin connectors after the built-ins."""
+        from ..sql.ddl import register_connector
+        register_connector(name, source=source, sink=sink)
+        self.connectors[name] = {"source": source, "sink": sink}
 
     def metric_reporter(self, name: str, factory: Callable) -> None:
+        """Reporter resolvable by name from metrics.reporters config."""
+        from ..metrics.reporters import register_reporter
+        register_reporter(name, factory)
         self.metric_reporters[name] = factory
 
 

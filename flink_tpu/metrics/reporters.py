@@ -18,7 +18,7 @@ from typing import Any, Callable, Optional
 from .core import Counter, Gauge, Histogram, Meter, MetricRegistry
 
 __all__ = ["MetricReporter", "PrometheusReporter", "LoggingReporter",
-           "prometheus_text"]
+           "prometheus_text", "register_reporter", "reporters_from_config"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -134,3 +134,33 @@ class LoggingReporter(MetricReporter):
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
+
+
+# -- name-based reporter loading (reference ReporterSetup.java:64) ----------
+
+_REPORTER_FACTORIES: dict[str, Callable[[], MetricReporter]] = {
+    "log": LoggingReporter,
+    "prometheus": PrometheusReporter,
+}
+
+
+def register_reporter(name: str,
+                      factory: Callable[[], MetricReporter]) -> None:
+    """Plugin seam: reporters resolve by name from metrics.reporters."""
+    _REPORTER_FACTORIES[name] = factory
+
+
+def reporters_from_config(config) -> list[MetricReporter]:
+    """Instantiate the reporters named in ``metrics.reporters`` (comma-
+    separated); unknown names raise with the known set."""
+    from ..core.config import MetricOptions
+
+    raw = config.get(MetricOptions.REPORTERS)
+    out = []
+    for name in (n.strip() for n in str(raw).split(",") if n.strip()):
+        factory = _REPORTER_FACTORIES.get(name)
+        if factory is None:
+            raise ValueError(f"unknown metric reporter {name!r} "
+                             f"(known: {sorted(_REPORTER_FACTORIES)})")
+        out.append(factory())
+    return out

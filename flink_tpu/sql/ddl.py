@@ -356,6 +356,17 @@ class Catalog:
 _BROKERS: dict[str, Any] = {}
 _BROKERS_LOCK = threading.Lock()
 
+# plugin connectors (core/plugins.py registry.connector): consulted AFTER
+# the built-ins; a factory provides source and/or sink construction
+_PLUGIN_CONNECTORS: dict[str, dict] = {}
+
+
+def register_connector(name: str, source=None, sink=None) -> None:
+    """Plugin seam (reference factory SPI discovery): ``source(env,
+    catalog_table) -> DataStream``; ``sink(catalog_table) -> Sink|
+    SinkFunction``."""
+    _PLUGIN_CONNECTORS[name] = {"source": source, "sink": sink}
+
 
 def _broker(name: str):
     """Named in-process broker, or a TCP client when the option looks like
@@ -473,6 +484,9 @@ def instantiate_source(env, entry: CatalogTable):
         src = SocketSource(opts.get("hostname", "127.0.0.1"),
                            int(opts["port"]), entry.schema)
         return env.from_source(src, ws, entry.name)
+    plugin = _PLUGIN_CONNECTORS.get(connector)
+    if plugin is not None and plugin.get("source") is not None:
+        return plugin["source"](env, entry)
     raise SqlError(f"unknown connector {connector!r} for source table "
                    f"{entry.name!r}")
 
@@ -512,5 +526,8 @@ def instantiate_sink(entry: CatalogTable):
                 return True
 
         return _Print()
+    plugin = _PLUGIN_CONNECTORS.get(connector)
+    if plugin is not None and plugin.get("sink") is not None:
+        return plugin["sink"](entry)
     raise SqlError(f"unknown connector {connector!r} for sink table "
                    f"{entry.name!r}")

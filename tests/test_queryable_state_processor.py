@@ -262,3 +262,40 @@ def test_savepoint_writer_transform(tmp_path):
                .write(str(tmp_path / "removed"), savepoint_id=3))
     r3 = SavepointReader.read(removed.external_path)
     assert r3.keyed_state(v, op_key, "cnt") == []
+
+
+# -- queryable state over the network ---------------------------------------
+
+def test_kvstate_server_and_remote_client():
+    """Network twin of the in-process client (reference KvStateServerImpl
+    + QueryableStateClient): a server fronts the live job's registry; a
+    TCP client reads keyed state, sees unknown names loudly, and survives
+    reconnection."""
+    from flink_tpu.state.queryable_net import (
+        KvStateServer, RemoteQueryableStateClient,
+    )
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    rows = [(i % 4, i) for i in range(40)]
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(40)))
+    ds.key_by("k").process(CountKeyed()).add_sink(_null_sink(), "sink")
+    job = env.execute("qstate-net")
+
+    srv = KvStateServer.for_job(job)
+    try:
+        client = RemoteQueryableStateClient(srv.address)
+        assert client.names() == ["q-counts"]
+        for k in range(4):
+            assert client.get_kv_state("q-counts", k) == 10
+        assert client.get_kv_state("q-counts", 99, default=-1) == -1
+        with pytest.raises(UnknownKvStateError):
+            client.get_kv_state("nope", 1)
+        # two clients share the server; server error keeps conns usable
+        client2 = RemoteQueryableStateClient(srv.address)
+        assert client2.get_kv_state("q-counts", 2) == 10
+        assert client.get_kv_state("q-counts", 3) == 10
+        client.close()
+        client2.close()
+    finally:
+        srv.close()
