@@ -29,25 +29,30 @@ __all__ = ["CEP", "Pattern", "PatternStream", "Match", "NFA",
 
 class PatternStream:
     def __init__(self, stream, pattern: Pattern, key: str,
-                 skip_strategy: str = NO_SKIP):
+                 skip_strategy: str = NO_SKIP,
+                 greedy_per_start: bool = False):
         self.stream = stream
         self.pattern = pattern
         self.key = key
         self.skip_strategy = skip_strategy
+        self.greedy_per_start = greedy_per_start
 
     def with_skip_strategy(self, strategy: str) -> "PatternStream":
-        return PatternStream(self.stream, self.pattern, self.key, strategy)
+        return PatternStream(self.stream, self.pattern, self.key, strategy,
+                             self.greedy_per_start)
 
     def _build(self, select_fn, out_schema: Schema, flat: bool):
         stages = self.pattern.compile()
         within = self.pattern.within_ms
         key = self.key
         skip = self.skip_strategy
+        greedy = self.greedy_per_start
         keyed = self.stream.key_by(key)
 
         def factory():
-            return CepOperator(NFA(stages, within, skip), key, select_fn,
-                               out_schema, flat_select=flat)
+            return CepOperator(
+                NFA(stages, within, skip, greedy_per_start=greedy), key,
+                select_fn, out_schema, flat_select=flat)
 
         out = keyed._one_input("CepOperator", factory,
                                key_extractor=keyed.key_extractor)

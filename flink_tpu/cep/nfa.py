@@ -25,6 +25,7 @@ __all__ = ["NFA", "Match", "NO_SKIP", "SKIP_PAST_LAST_EVENT"]
 
 NO_SKIP = "no_skip"
 SKIP_PAST_LAST_EVENT = "skip_past_last_event"
+SKIP_TO_NEXT_ROW = "skip_to_next_row"
 
 
 @dataclass(frozen=True)
@@ -59,12 +60,32 @@ class _Partial:
     ignored_since_advance: int   # events ignored since last take/proceed
 
 
+@dataclass
+class _PendingBest:
+    """Deferred match candidate for greedy-per-start selection: the best
+    (longest) completed match for one start row, held back while a live
+    partial with the same start could still grow into a longer one.
+    Lives inside the partials list so it checkpoints with keyed state."""
+
+    start_seq: int
+    start_ts: int
+    match: Match
+
+
 class NFA:
     def __init__(self, stages: list, within_ms: Optional[int] = None,
-                 skip_strategy: str = NO_SKIP):
+                 skip_strategy: str = NO_SKIP,
+                 greedy_per_start: bool = False):
+        """``greedy_per_start`` defers emission so exactly ONE match — the
+        longest — comes out per start row (SQL:2016 MATCH_RECOGNIZE
+        quantifier greediness, resolved by deferral instead of
+        backtracking). Combine with SKIP_PAST_LAST_EVENT for AFTER MATCH
+        SKIP PAST LAST ROW, or SKIP_TO_NEXT_ROW for one-match-per-start
+        without overlap pruning."""
         self.stages = stages
         self.within_ms = within_ms
         self.skip = skip_strategy
+        self.greedy_per_start = greedy_per_start
         # positive stage indices in order; negatives act as guards between
         self.pos: list[int] = [i for i, s in enumerate(stages)
                                if not s.negated]
@@ -96,6 +117,22 @@ class NFA:
             j += 1
         return out
 
+    def _captured_ctx(self, captured: tuple):
+        """Lazy, memoized {stage name: [event dicts]} view of a partial's
+        captured events, for context predicates (pattern.Stage.ctx_preds).
+        matches() can run several times per event per partial (stage,
+        guards, next candidates) — build once."""
+        cache: list = []
+
+        def build() -> dict:
+            if not cache:
+                out: dict[str, list] = {}
+                for si, ev in captured:
+                    out.setdefault(self.stages[si].name, []).append(ev.data)
+                cache.append(out)
+            return cache[0]
+        return build
+
     def _is_final(self, pi: int, count: int) -> bool:
         if count < self._stage(pi).min_count:
             return False
@@ -103,11 +140,59 @@ class NFA:
         return all(self._stage(j).optional
                    for j in range(pi + 1, len(self.pos)))
 
+    # -- greedy-per-start deferral ----------------------------------------
+    @staticmethod
+    def _match_rank(m: Match) -> tuple:
+        return (m.last_seq, sum(len(v) for v in m.events.values()))
+
+    def _resolve_pending(self, pending: list, raw: list, live: list,
+                         flush_all: bool = False) -> tuple[list, list]:
+        """Merge newly completed matches into the per-start bests; release
+        a best once nothing live could still extend OR PRECEDE it (an
+        earlier live start may yet produce a match that skip-past-last
+        would prefer). Returns (still_pending, released_matches)."""
+        by_start = {pb.start_seq: pb for pb in pending}
+        for m in raw:
+            cur = by_start.get(m.start_seq)
+            if cur is None or self._match_rank(m) > self._match_rank(
+                    cur.match):
+                by_start[m.start_seq] = _PendingBest(m.start_seq,
+                                                     m.start_ts, m)
+        live_starts = {p.start_seq for p in live}
+        min_live = min(live_starts) if live_starts else None
+        released: list[Match] = []
+        still: list[_PendingBest] = []
+        horizon = -1
+        for pb in sorted(by_start.values(), key=lambda x: x.start_seq):
+            if pb.start_seq <= horizon:
+                continue                      # overlapped a released match
+            blocked = (not flush_all
+                       and (pb.start_seq in live_starts
+                            or (self.skip == SKIP_PAST_LAST_EVENT
+                                and min_live is not None
+                                and min_live < pb.start_seq)))
+            if blocked:
+                still.append(pb)
+                continue
+            released.append(pb.match)
+            if self.skip == SKIP_PAST_LAST_EVENT:
+                horizon = pb.match.last_seq
+        if horizon >= 0:
+            live = [p for p in live if p.start_seq > horizon]
+            still = [pb for pb in still if pb.start_seq > horizon]
+        return still + live, released
+
     # -- core --------------------------------------------------------------
     def advance(self, partials: list, event: Event
                 ) -> tuple[list, list]:
         """One event through all partials + the start state. Returns
         (new partials, matches)."""
+        pending: list[_PendingBest] = []
+        if self.greedy_per_start:
+            pending = [p for p in partials
+                       if isinstance(p, _PendingBest)]
+            partials = [p for p in partials
+                        if not isinstance(p, _PendingBest)]
         out: list[_Partial] = []
         matches: list[Match] = []
         seen_match_keys: set = set()
@@ -155,11 +240,15 @@ class NFA:
                                   else self._next_candidates(0))
         for pi in start_candidates:
             s = self._stage(pi)
-            if not s.negated and s.matches(event.data):
+            if not s.negated and s.matches(event.data,
+                                           self._captured_ctx(())):
                 p = _Partial(pi, 1, True, ((self.pos[pi], event),),
                              event.ts, event.seq, 0)
                 offer(p)
                 break  # only the first stage that matches starts the run
+
+        if self.greedy_per_start:
+            return self._resolve_pending(pending, matches, out)
 
         if self.skip == SKIP_PAST_LAST_EVENT and matches:
             # keep the earliest-starting match, drop matches and partials
@@ -179,7 +268,8 @@ class NFA:
         """TAKE / PROCEED / IGNORE branching for one partial."""
         s = self._stage(p.stage)
         branches: list[_Partial] = []
-        e_matches = s.matches(event.data)
+        ctx = self._captured_ctx(p.captured)
+        e_matches = s.matches(event.data, ctx)
 
         # until() stops the loop from taking (event not consumed)
         taking = p.taking
@@ -203,14 +293,14 @@ class NFA:
         if can_proceed:
             guards = self._guards_between(p.stage)
             guard_hit = any(
-                g.matches(event.data)
+                g.matches(event.data, ctx)
                 and (g.contiguity != STRICT or p.ignored_since_advance == 0)
                 for g in guards)
             if guard_hit:
                 return branches  # NOT pattern matched: path dies
             for pj in self._next_candidates(p.stage):
                 nxt = self._stage(pj)
-                if nxt.matches(event.data):
+                if nxt.matches(event.data, ctx):
                     emit_offer(replace(
                         p, stage=pj, count=1, taking=True,
                         captured=p.captured + ((self.pos[pj], event),),
@@ -230,11 +320,15 @@ class NFA:
             if cont == RELAXED and took:
                 ignore_ok = False
             # waiting for next stage is always allowed once min met, unless
-            # a strict next stage saw a non-matching event
+            # the next stage is STRICT: then THIS event was its only
+            # candidate — if the stage didn't extend, the wait dies whether
+            # or not a proceed branch was spawned (the branch carries on;
+            # letting the source also linger would match the strict stage
+            # against a LATER, non-consecutive event)
             if p.count >= s.min_count:
                 nxts = self._next_candidates(p.stage)
                 if nxts and self._stage(nxts[0]).contiguity == STRICT \
-                        and not took and not proceeded:
+                        and not took:
                     ignore_ok = False
         else:
             if cont == STRICT and not took:
@@ -264,16 +358,30 @@ class NFA:
                              max(e.seq for _, e in p.captured),
                              p.start_seq))
 
+    END_OF_STREAM_TS = 1 << 61   # watermark at/above this = no more input
+
     def prune(self, partials: list, watermark_ts: int) -> tuple[list, list]:
         """Drop partials whose within-window has passed; deferred
-        trailing-NOT matches fire here."""
+        trailing-NOT matches fire here. In greedy-per-start mode a prune
+        also re-resolves pending bests: timed-out partials can no longer
+        extend them, and end-of-stream releases everything."""
+        pending: list[_PendingBest] = []
+        if self.greedy_per_start:
+            pending = [p for p in partials if isinstance(p, _PendingBest)]
+            partials = [p for p in partials
+                        if not isinstance(p, _PendingBest)]
+        end_of_stream = watermark_ts >= self.END_OF_STREAM_TS
         if self.within_ms is None:
-            return partials, []
-        kept, matches = [], []
-        for p in partials:
-            if watermark_ts - p.start_ts > self.within_ms:
-                self._flush_deferred(p, p.start_ts + self.within_ms,
-                                     emit_fn=matches)
-            else:
-                kept.append(p)
+            kept, matches = list(partials), []
+        else:
+            kept, matches = [], []
+            for p in partials:
+                if watermark_ts - p.start_ts > self.within_ms:
+                    self._flush_deferred(p, p.start_ts + self.within_ms,
+                                         emit_fn=matches)
+                else:
+                    kept.append(p)
+        if self.greedy_per_start:
+            return self._resolve_pending(pending, matches, kept,
+                                         flush_all=end_of_stream)
         return kept, matches

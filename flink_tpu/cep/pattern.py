@@ -35,6 +35,11 @@ class Stage:
     name: str
     contiguity: str = RELAXED        # vs the previous stage
     preds: list = field(default_factory=list)       # OR-combined
+    # context predicates: p(event, by_name) where by_name maps pattern
+    # names to the events captured SO FAR in this partial (reference
+    # IterativeCondition.Context.getEventsForPattern — what SQL
+    # MATCH_RECOGNIZE DEFINE clauses compile to)
+    ctx_preds: list = field(default_factory=list)
     until: Optional[Predicate] = None
     min_count: int = 1
     max_count: Optional[int] = 1     # None = unbounded
@@ -43,10 +48,18 @@ class Stage:
     greedy: bool = False
     inner_contiguity: str = RELAXED  # within a loop (consecutive -> strict)
 
-    def matches(self, event: dict) -> bool:
-        if not self.preds:
+    def matches(self, event: dict, ctx: Optional[Callable] = None) -> bool:
+        """``ctx`` lazily materializes {pattern name: [event dict, ...]}
+        for context predicates; omitted where no history exists (fresh
+        start state)."""
+        if not self.preds and not self.ctx_preds:
             return True
-        return any(p(event) for p in self.preds)
+        if any(p(event) for p in self.preds):
+            return True
+        if self.ctx_preds:
+            by_name = ctx() if ctx is not None else {}
+            return any(p(event, by_name) for p in self.ctx_preds)
+        return False
 
     @property
     def looping(self) -> bool:
@@ -99,6 +112,14 @@ class Pattern:
 
     def or_(self, pred: Predicate) -> "Pattern":
         return self.where(pred)
+
+    def where_with_history(self, pred: Callable[[dict, dict], bool]
+                           ) -> "Pattern":
+        """Condition over (event, {name: [captured event dicts]}) — the
+        reference's IterativeCondition; SQL MATCH_RECOGNIZE DEFINE clauses
+        referencing other pattern variables lower to this."""
+        self._last().ctx_preds.append(pred)
+        return self
 
     def until(self, pred: Predicate) -> "Pattern":
         if not self._last().looping:

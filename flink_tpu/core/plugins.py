@@ -34,8 +34,18 @@ class PluginRegistry:
 
     def __init__(self):
         self.loaded: list[str] = []           # plugin names, for inspection
-        self.connectors: dict[str, Callable] = {}
-        self.metric_reporters: dict[str, Callable] = {}
+
+    @property
+    def connectors(self) -> dict:
+        """Read-through view of the single source of truth (the DDL
+        layer's process-global connector table)."""
+        from ..sql.ddl import _PLUGIN_CONNECTORS
+        return dict(_PLUGIN_CONNECTORS)
+
+    @property
+    def metric_reporters(self) -> dict:
+        from ..metrics.reporters import _REPORTER_FACTORIES
+        return dict(_REPORTER_FACTORIES)
 
     def filesystem(self, scheme: str, factory: Callable) -> None:
         from .fs import register_filesystem
@@ -52,13 +62,11 @@ class PluginRegistry:
         consults plugin connectors after the built-ins."""
         from ..sql.ddl import register_connector
         register_connector(name, source=source, sink=sink)
-        self.connectors[name] = {"source": source, "sink": sink}
 
     def metric_reporter(self, name: str, factory: Callable) -> None:
         """Reporter resolvable by name from metrics.reporters config."""
         from ..metrics.reporters import register_reporter
         register_reporter(name, factory)
-        self.metric_reporters[name] = factory
 
 
 class PluginManager:

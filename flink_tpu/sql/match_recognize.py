@@ -1,0 +1,258 @@
+"""MATCH_RECOGNIZE lowering: SQL row-pattern recognition onto the CEP NFA.
+
+Reference: flink-table's match-recognize support compiles the SQL:2016
+clause into the flink-cep operator (StreamExecMatch ->
+CepOperator; MATCH_RECOGNIZE docs in dev/table/sql/queries/match_recognize)
+— the same lowering happens here against cep/pattern.py + cep/operator.py:
+
+* PATTERN variables become NFA stages with STRICT contiguity (row pattern
+  matching is over consecutive rows per partition), quantifiers ``+ * ?``
+  map to one_or_more/optional loops with ``consecutive()`` inner
+  contiguity and SQL's default greediness;
+* DEFINE clauses become stage conditions; references to OTHER pattern
+  variables (``B.v > A.v``) need the partial match's history, so they
+  lower to ``where_with_history`` (the IterativeCondition analog);
+* MEASURES evaluate over the completed match: ``FIRST(X.c)``/``LAST(X.c)``
+  /``X.c`` (= LAST) plus arithmetic; output schema = partition columns +
+  measures;
+* AFTER MATCH SKIP PAST LAST ROW is the NFA's SKIP_PAST_LAST_EVENT
+  strategy; SKIP TO NEXT ROW is the NFA's default (every row may start a
+  match).
+
+Expressions evaluate per ROW here (a match is a handful of events), unlike
+the planner's vectorized column programs — pattern matching is inherently
+sequential, which is also why the reference runs it in flink-cep rather
+than generated columnar code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..cep import Pattern
+from ..cep.nfa import SKIP_PAST_LAST_EVENT, SKIP_TO_NEXT_ROW
+from ..core.records import Schema
+from .expressions import (
+    BinaryOp, CaseWhen, Column, Expr, FuncCall, Literal, UnaryOp,
+)
+from .parser import MatchRecognize, SqlError
+
+__all__ = ["plan_match_recognize"]
+
+
+# -- scalar expression evaluation -------------------------------------------
+
+def _binop(op: str, a, b):
+    if a is None or b is None:
+        return None                      # SQL three-valued: unknown
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b if b else None
+    if op == "%":
+        return a % b if b else None
+    if op == "=":
+        return a == b
+    if op in ("<>", "!="):
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    if op == "AND":
+        return bool(a) and bool(b)
+    if op == "OR":
+        return bool(a) or bool(b)
+    raise SqlError(f"MATCH_RECOGNIZE: unsupported operator {op!r}")
+
+
+def _eval(e: Expr, resolve: Callable[[Optional[str], str, str], Any]) -> Any:
+    """``resolve(var_or_None, column, mode)`` fetches a value; mode is
+    "last" or "first"."""
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, Column):
+        return resolve(e.table, e.name, "last")
+    if isinstance(e, BinaryOp):
+        return _binop(e.op.upper(), _eval(e.left, resolve),
+                      _eval(e.right, resolve))
+    if isinstance(e, UnaryOp):
+        v = _eval(e.operand, resolve)
+        if v is None:
+            return None
+        if e.op.upper() == "NOT":
+            return not v
+        if e.op == "-":
+            return -v
+        raise SqlError(f"MATCH_RECOGNIZE: unary {e.op!r} unsupported")
+    if isinstance(e, FuncCall):
+        fname = e.name.upper()
+        if fname in ("FIRST", "LAST"):
+            if len(e.args) != 1 or not isinstance(e.args[0], Column) \
+                    or e.args[0].table is None:
+                raise SqlError(f"{fname}() takes one VAR.column argument")
+            c = e.args[0]
+            return resolve(c.table, c.name, fname.lower())
+        raise SqlError(f"MATCH_RECOGNIZE: function {fname!r} unsupported "
+                       "(FIRST/LAST)")
+    if isinstance(e, CaseWhen):
+        for cond, then in e.branches:
+            if _eval(cond, resolve):
+                return _eval(then, resolve)
+        return _eval(e.default, resolve) if e.default is not None \
+            else None
+    raise SqlError(f"MATCH_RECOGNIZE: unsupported expression {type(e).__name__}")
+
+
+def _define_predicate(var: str, expr: Expr):
+    """DEFINE var AS expr -> condition over (event, history)."""
+
+    def pred(event: dict, by_name: dict) -> bool:
+        def resolve(qual: Optional[str], col: str, mode: str):
+            if qual is None or qual == var:
+                # the current row is provisionally mapped to var: LAST(var)
+                # IS the current row; FIRST(var) is the first already-
+                # captured row, falling back to the current one (SQL:2016
+                # running semantics, matching the reference)
+                if mode == "first":
+                    events = by_name.get(var)
+                    if events:
+                        return events[0].get(col)
+                return event.get(col)
+            events = by_name.get(qual)
+            if not events:
+                return None              # nothing captured yet -> unknown
+            row = events[0] if mode == "first" else events[-1]
+            return row.get(col)
+
+        return bool(_eval(expr, resolve))
+
+    return pred
+
+
+def _uses_history(var: str, e: Expr) -> bool:
+    if isinstance(e, Column):
+        return e.table is not None and e.table != var
+    if isinstance(e, BinaryOp):
+        return _uses_history(var, e.left) or _uses_history(var, e.right)
+    if isinstance(e, UnaryOp):
+        return _uses_history(var, e.operand)
+    if isinstance(e, FuncCall):
+        if e.name.upper() == "FIRST":
+            return True   # FIRST of the OWN variable reads captured rows
+        return any(_uses_history(var, a) for a in e.args)
+    if isinstance(e, CaseWhen):
+        return (any(_uses_history(var, c) or _uses_history(var, t)
+                    for c, t in e.branches)
+                or (e.default is not None
+                    and _uses_history(var, e.default)))
+    return False
+
+
+def _measure_fn(measures: list, partition_by: list):
+    """Match -> output row of partition values + measure values."""
+
+    def compute(match) -> tuple:
+        events = match.events if hasattr(match, "events") else match
+
+        def resolve(qual: Optional[str], col: str, mode: str):
+            if qual is None:
+                raise SqlError(
+                    f"MEASURES column {col!r} must be qualified with a "
+                    "pattern variable (e.g. A.{col})")
+            rows = events.get(qual)
+            if not rows:
+                return None
+            row = rows[0] if mode == "first" else rows[-1]
+            return row.get(col)
+
+        first_var_rows = next((v for v in events.values() if v), None)
+        out = []
+        for col in partition_by:
+            out.append(first_var_rows[0].get(col)
+                       if first_var_rows else None)
+        for expr, _alias in measures:
+            out.append(_eval(expr, resolve))
+        return tuple(out)
+
+    return compute
+
+
+def _build_pattern(mr: MatchRecognize) -> Pattern:
+    pat: Optional[Pattern] = None
+    for i, (var, quant) in enumerate(mr.pattern):
+        if pat is None:
+            pat = Pattern.begin(var)     # first stage: match may start at
+        else:                            # any row (relaxed vs stream head)
+            pat = pat.next(var)          # row patterns are consecutive
+        if quant == "+":
+            # NOT .greedy(): the NFA has no backtracking, so a greedy loop
+            # that swallows a row the NEXT variable needed would kill the
+            # match SQL semantics produce. Branching TAKE/PROCEED explores
+            # both; the NFA's greedy_per_start deferral then releases the
+            # LONGEST completed match per start row — SQL greediness via
+            # deferral instead of backtracking.
+            pat.one_or_more().consecutive()
+        elif quant == "*":
+            pat.times_or_more(0).optional().consecutive()
+        elif quant == "?":
+            pat.optional()
+        define = mr.defines.get(var)
+        if define is not None:
+            if _uses_history(var, define):
+                pat.where_with_history(_define_predicate(var, define))
+            else:
+                pred = _define_predicate(var, define)
+                pat.where(lambda e, _p=pred: _p(e, {}))
+        # no DEFINE: variable matches any row (SQL default)
+    if mr.within_ms is not None:
+        pat.within(mr.within_ms)
+    return pat
+
+
+def plan_match_recognize(mr: MatchRecognize, stream, in_schema: Schema,
+                         env):
+    """Lower the clause onto the input DataStream; returns the derived
+    stream with ``_sql_schema`` = partition columns + measures."""
+    from ..cep import PatternStream
+
+    for col in mr.partition_by + [mr.order_by]:
+        if col not in in_schema:
+            raise SqlError(f"MATCH_RECOGNIZE: column {col!r} not in input "
+                           f"schema {list(in_schema.names)}")
+    if not mr.partition_by:
+        raise SqlError("MATCH_RECOGNIZE needs PARTITION BY (the keyed "
+                       "contract of the CEP operator)")
+    if len(mr.partition_by) > 1:
+        raise SqlError("MATCH_RECOGNIZE supports one PARTITION BY column")
+    out_fields = [(c, in_schema.field(c).dtype) for c in mr.partition_by]
+    for expr, alias in mr.measures:
+        # measure dtype: the referenced column's dtype when directly
+        # resolvable, else float64 (arithmetic)
+        dtype: Any = np.float64
+        base = expr
+        if isinstance(base, FuncCall) and base.args:
+            base = base.args[0]
+        if isinstance(base, Column) and base.name in in_schema:
+            dtype = in_schema.field(base.name).dtype
+        out_fields.append((alias, dtype))
+    out_schema = Schema(out_fields)
+
+    pattern = _build_pattern(mr)
+    skip = (SKIP_PAST_LAST_EVENT if mr.after_match == "SKIP PAST LAST ROW"
+            else SKIP_TO_NEXT_ROW)
+    ps = PatternStream(stream, pattern, mr.partition_by[0],
+                       skip_strategy=skip, greedy_per_start=True)
+    out = ps.select(_measure_fn(mr.measures, mr.partition_by), out_schema)
+    out._sql_schema = out_schema
+    return out

@@ -27,6 +27,7 @@ from .expressions import (
 )
 
 __all__ = ["parse", "SelectStmt", "TableRef", "JoinClause", "WindowTVF",
+           "MatchRecognize",
            "OrderItem", "SelectItem", "SqlError"]
 
 _AGG_FUNCS = {"COUNT", "SUM", "MIN", "MAX", "AVG"}
@@ -74,6 +75,23 @@ class WindowTVF:
 
 
 @dataclass
+class MatchRecognize:
+    """MATCH_RECOGNIZE over a table (reference flink-table match-recognize
+    -> flink-cep lowering; SQL:2016 row pattern recognition)."""
+
+    table: "TableRef"
+    partition_by: list          # [column name]
+    order_by: str               # time attribute column
+    measures: list              # [(Expr, alias)]
+    pattern: list               # [(var, quantifier)] quantifier in
+                                # {"", "+", "*", "?"} or (min, max|None)
+    defines: dict               # var -> Expr
+    after_match: str = "SKIP PAST LAST ROW"
+    within_ms: Optional[int] = None
+    alias: Optional[str] = None
+
+
+@dataclass
 class OrderItem:
     expr: Expr
     descending: bool = False
@@ -91,7 +109,8 @@ class SelectStmt:
     alias: Optional[str] = None  # derived-table alias: (SELECT ...) s
 
 
-FromClause = Union[TableRef, WindowTVF, SelectStmt, "JoinClause"]
+FromClause = Union[TableRef, WindowTVF, SelectStmt, "JoinClause",
+                   "MatchRecognize"]
 
 
 _TOKEN_RE = re.compile(r"""
@@ -99,7 +118,7 @@ _TOKEN_RE = re.compile(r"""
       (?P<num>\d+\.\d+|\d+)
     | (?P<str>'(?:[^']|'')*')
     | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
-    | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.)
+    | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.|\?)
     )""", re.VERBOSE)
 
 
@@ -252,6 +271,8 @@ class _Parser:
         if k != "id":
             raise SqlError(f"expected table name, got {v!r}")
         self.next()
+        if self.at_kw("MATCH_RECOGNIZE"):
+            return self.match_recognize(TableRef(v))
         return TableRef(v, self.maybe_alias())
 
     def from_clause_inner(self) -> FromClause:
@@ -306,6 +327,113 @@ class _Parser:
         if kind == "TUMBLE":
             return WindowTVF(kind, TableRef(tname), time_col, size)
         return WindowTVF(kind, TableRef(tname), time_col, size, slide)
+
+    def match_recognize(self, table: TableRef) -> MatchRecognize:
+        """MATCH_RECOGNIZE ( PARTITION BY col ORDER BY col MEASURES ...
+        [ONE ROW PER MATCH] [AFTER MATCH SKIP ...] PATTERN (A B+ C)
+        [WITHIN INTERVAL ...] DEFINE var AS expr, ... )"""
+        self.expect_kw("MATCH_RECOGNIZE")
+        self.expect_op("(")
+        partition_by: list[str] = []
+        if self.eat_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self._ident("PARTITION BY column"))
+            while self.eat_op(","):
+                partition_by.append(self._ident("PARTITION BY column"))
+        self.expect_kw("ORDER")
+        self.expect_kw("BY")
+        order_by = self._ident("ORDER BY column")
+        self.expect_kw("MEASURES")
+        measures = [self._measure()]
+        while self.eat_op(","):
+            measures.append(self._measure())
+        if self.eat_kw("ONE"):
+            self.expect_kw("ROW")
+            self.expect_kw("PER")
+            self.expect_kw("MATCH")
+        after = "SKIP PAST LAST ROW"
+        if self.eat_kw("AFTER"):
+            self.expect_kw("MATCH")
+            self.expect_kw("SKIP")
+            if self.eat_kw("PAST"):
+                self.expect_kw("LAST")
+                self.expect_kw("ROW")
+            elif self.eat_kw("TO"):
+                self.expect_kw("NEXT")
+                self.expect_kw("ROW")
+                after = "SKIP TO NEXT ROW"
+            else:
+                raise SqlError("AFTER MATCH SKIP supports PAST LAST ROW "
+                               "and TO NEXT ROW")
+        self.expect_kw("PATTERN")
+        self.expect_op("(")
+        pattern: list[tuple[str, Any]] = []
+        while not self.eat_op(")"):
+            var = self._ident("pattern variable")
+            quant: Any = ""
+            if self.eat_op("+"):
+                quant = "+"
+            elif self.eat_op("*"):
+                quant = "*"
+            elif self.eat_op("?"):
+                quant = "?"
+            pattern.append((var, quant))
+        if not pattern:
+            raise SqlError("empty PATTERN")
+        within_ms = None
+        if self.eat_kw("WITHIN"):
+            within_ms = self.interval()
+        self.expect_kw("DEFINE")
+        defines: dict[str, Expr] = {}
+        var = self._ident("DEFINE variable")
+        self.expect_kw("AS")
+        defines[var] = self.expr()
+        while self.eat_op(","):
+            var = self._ident("DEFINE variable")
+            self.expect_kw("AS")
+            defines[var] = self.expr()
+        self.expect_op(")")
+        alias = self.maybe_alias()
+        known = {v for v, _ in pattern}
+        for var in defines:
+            if var not in known:
+                raise SqlError(f"DEFINE references unknown pattern "
+                               f"variable {var!r} (pattern: {sorted(known)})")
+
+        def check_vars(e) -> None:
+            if isinstance(e, Column) and e.table is not None \
+                    and e.table not in known:
+                raise SqlError(
+                    f"MEASURES references unknown pattern variable "
+                    f"{e.table!r} (pattern: {sorted(known)})")
+            for attr in ("left", "right", "operand"):
+                sub = getattr(e, attr, None)
+                if sub is not None:
+                    check_vars(sub)
+            for sub in getattr(e, "args", ()) or ():
+                check_vars(sub)
+            for c, t in getattr(e, "branches", ()) or ():
+                check_vars(c)
+                check_vars(t)
+            default = getattr(e, "default", None)
+            if default is not None:
+                check_vars(default)
+
+        for m_expr, _alias in measures:
+            check_vars(m_expr)
+        return MatchRecognize(table, partition_by, order_by, measures,
+                              pattern, defines, after, within_ms, alias)
+
+    def _ident(self, what: str) -> str:
+        k, v = self.next()
+        if k != "id":
+            raise SqlError(f"expected {what}, got {v!r}")
+        return v
+
+    def _measure(self) -> tuple:
+        e = self.expr()
+        self.expect_kw("AS")
+        return (e, self._ident("measure alias"))
 
     def interval(self) -> int:
         self.expect_kw("INTERVAL")

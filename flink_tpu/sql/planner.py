@@ -92,6 +92,16 @@ class _Planner:
             return sub, sub._sql_schema, None, quals
         if isinstance(from_, JoinClause):
             return self.plan_join(from_)
+        from .parser import MatchRecognize
+        if isinstance(from_, MatchRecognize):
+            from .match_recognize import plan_match_recognize
+            ds, schema = self.resolve(from_.table.name)
+            out = plan_match_recognize(from_, ds, schema, self.env)
+            alias = from_.alias
+            quals = ({alias: {f.name: f.name
+                              for f in out._sql_schema.fields}}
+                     if alias else {})
+            return out, out._sql_schema, None, quals
         raise PlanError(f"unsupported FROM clause {from_!r}")
 
     # -- JOIN --------------------------------------------------------------

@@ -1,0 +1,214 @@
+"""SQL MATCH_RECOGNIZE -> CEP lowering (reference test models:
+MatchRecognizeITCase, flink-cep NFA iterative-condition tests)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.core.records import Schema
+from flink_tpu.sql import TableEnvironment
+from flink_tpu.sql.parser import MatchRecognize, SqlError, parse
+
+SCHEMA = Schema([("sym", np.int64), ("price", np.int64), ("ts", np.int64)])
+
+
+def _t_env(rows):
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    t = TableEnvironment(env)
+    ds = env.from_collection(rows, SCHEMA,
+                             timestamps=[r[2] for r in rows])
+    t.create_temporary_view("ticks", ds, SCHEMA)
+    return t
+
+
+# -- parsing ---------------------------------------------------------------
+
+def test_parse_clause_shape():
+    stmt = parse("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES FIRST(A.price) AS start_p, LAST(B.price) AS bottom_p,
+                     C.price AS end_p
+            ONE ROW PER MATCH
+            AFTER MATCH SKIP PAST LAST ROW
+            PATTERN (A B+ C)
+            DEFINE B AS B.price < A.price, C AS C.price > LAST(B.price)
+        )""")
+    mr = stmt.from_
+    assert isinstance(mr, MatchRecognize)
+    assert mr.partition_by == ["sym"] and mr.order_by == "ts"
+    assert [v for v, _ in mr.pattern] == ["A", "B", "C"]
+    assert mr.pattern[1][1] == "+"
+    assert set(mr.defines) == {"B", "C"}
+    assert [a for _, a in mr.measures] == ["start_p", "bottom_p", "end_p"]
+
+
+def test_parse_rejects_unknown_define_var():
+    with pytest.raises(SqlError, match="unknown pattern"):
+        parse("SELECT * FROM t MATCH_RECOGNIZE (PARTITION BY k ORDER BY ts "
+              "MEASURES A.v AS x PATTERN (A) DEFINE Z AS Z.v > 0)")
+
+
+# -- end-to-end: the classic V-shape (dip then recovery) --------------------
+
+def test_v_shape_detection():
+    """Price dips below the start then recovers above the last dip row:
+    MEASURES pull FIRST/LAST across the B+ loop."""
+    rows = [
+        # sym 1: 10, 8, 6, 9  -> V: A=10, B=[8,6], C=9
+        (1, 10, 1000), (1, 8, 2000), (1, 6, 3000), (1, 9, 4000),
+        # sym 2: monotonically rising -> no match
+        (2, 5, 1000), (2, 6, 2000), (2, 7, 3000), (2, 8, 4000),
+    ]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES FIRST(A.price) AS start_p, LAST(B.price) AS bottom_p,
+                     C.price AS end_p
+            PATTERN (A B+ C)
+            DEFINE B AS B.price < A.price,
+                   C AS C.price > LAST(B.price)
+        )""").collect_final()
+    assert len(got) == 1
+    sym, start_p, bottom_p, end_p = got[0]
+    assert (sym, start_p, bottom_p, end_p) == (1, 10, 6, 9)
+
+
+def test_cross_variable_define_uses_history():
+    """B's DEFINE references A's captured row — the IterativeCondition
+    path: only rises RELATIVE TO the anchor match."""
+    rows = [
+        (7, 100, 1000), (7, 150, 2000),   # A=100, B=150 (> A) -> match
+        (7, 90, 3000), (7, 80, 4000),     # A=90, B=80 -> no (80 < 90)
+        (7, 70, 5000), (7, 200, 6000),    # A=70, B=200 -> match
+    ]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES A.price AS a_p, B.price AS b_p
+            PATTERN (A B)
+            DEFINE B AS B.price > A.price + 10
+        )""").collect_final()
+    pairs = sorted((r[1], r[2]) for r in got)
+    assert pairs == [(70, 200), (100, 150)]
+
+
+def test_partitions_are_independent():
+    rows = [
+        (1, 1, 1000), (2, 9, 1500), (1, 2, 2000), (2, 3, 2500),
+    ]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES A.price AS a_p, B.price AS b_p
+            PATTERN (A B)
+            DEFINE B AS B.price > A.price
+        )""").collect_final()
+    # sym 1: 1 -> 2 rises (match); sym 2: 9 -> 3 falls (no match)
+    assert got == [(1, 1, 2)]
+
+
+def test_optional_variable():
+    rows = [(3, 1, 1000), (3, 5, 2000), (3, 2, 3000)]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES A.price AS a_p, C.price AS c_p
+            PATTERN (A B? C)
+            DEFINE A AS A.price < 2,
+                   B AS B.price > 4,
+                   C AS C.price = 2
+        )""").collect_final()
+    assert got == [(3, 1, 2)]
+
+
+def test_projection_over_match_output():
+    rows = [(1, 10, 1000), (1, 8, 2000), (1, 6, 3000), (1, 9, 4000)]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT bottom_p, end_p - bottom_p FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES LAST(B.price) AS bottom_p, C.price AS end_p
+            PATTERN (A B+ C)
+            DEFINE B AS B.price < A.price, C AS C.price > LAST(B.price)
+        )""").collect_final()
+    assert got == [(6, 3)]
+
+
+def test_within_bounds_match_window():
+    rows = [(5, 10, 0), (5, 5, 100_000)]     # dip arrives 100s later
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES A.price AS a_p, B.price AS b_p
+            PATTERN (A B)
+            WITHIN INTERVAL '10' SECOND
+            DEFINE B AS B.price < A.price
+        )""").collect_final()
+    assert got == []                          # outside the 10s window
+
+
+def test_greedy_quantifier_takes_longest_match():
+    """SQL:2016 greediness: B+ grabs [8,9], not just [8] — resolved by the
+    NFA's deferred best-per-start selection (review counterexample)."""
+    rows = [(1, 10, 1000), (1, 8, 2000), (1, 9, 3000), (1, 11, 4000)]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES FIRST(B.price) AS first_b, LAST(B.price) AS last_b,
+                     C.price AS c_p
+            PATTERN (A B+ C)
+            DEFINE B AS B.price < 10, C AS C.price > 8
+        )""").collect_final()
+    assert got == [(1, 8, 9, 11)]
+
+
+def test_skip_to_next_row_one_match_per_start():
+    rows = [(1, 10, 1000), (1, 8, 2000), (1, 9, 3000), (1, 11, 4000)]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES FIRST(B.price) AS first_b, LAST(B.price) AS last_b,
+                     C.price AS c_p
+            AFTER MATCH SKIP TO NEXT ROW
+            PATTERN (A B+ C)
+            DEFINE B AS B.price < 10, C AS C.price > 8
+        )""").collect_final()
+    # one (longest) match per start row; starts at 10 and at 8 both work:
+    # A=10 B=[8,9] C=11 and A=8 B=[9] C=11
+    assert sorted(got) == [(1, 8, 9, 11), (1, 9, 9, 11)]
+    assert len(got) == len(set(got))     # no duplicates
+
+
+def test_first_of_own_variable_in_define():
+    """FIRST(B.price) inside B's DEFINE reads the first CAPTURED B row
+    (review counterexample: 20 must not pass 'B.price <= FIRST(B.price)'
+    against itself)."""
+    rows = [(1, 10, 1000), (1, 8, 2000), (1, 20, 3000), (1, 5, 4000)]
+    t = _t_env(rows)
+    got = t.execute_sql("""
+        SELECT * FROM ticks MATCH_RECOGNIZE (
+            PARTITION BY sym ORDER BY ts
+            MEASURES FIRST(B.price) AS first_b, LAST(B.price) AS last_b
+            PATTERN (A B+)
+            DEFINE B AS B.price <= FIRST(B.price)
+        )""").collect_final()
+    # B anchors at 8; 20 > FIRST(B)=8 fails; the longest run from the
+    # earliest start is A=10, B=[8]
+    assert (1, 8, 8) in got
+    assert not any(r[2] == 20 for r in got)
+
+
+def test_measures_unknown_variable_rejected_at_parse():
+    with pytest.raises(SqlError, match="unknown pattern"):
+        parse("SELECT * FROM t MATCH_RECOGNIZE (PARTITION BY k ORDER BY ts "
+              "MEASURES Z.price AS zp PATTERN (A B) "
+              "DEFINE B AS B.price < A.price)")
