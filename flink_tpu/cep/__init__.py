@@ -30,16 +30,18 @@ __all__ = ["CEP", "Pattern", "PatternStream", "Match", "NFA",
 class PatternStream:
     def __init__(self, stream, pattern: Pattern, key: str,
                  skip_strategy: str = NO_SKIP,
-                 greedy_per_start: bool = False):
+                 greedy_per_start: bool = False,
+                 order_column: str = None):
         self.stream = stream
         self.pattern = pattern
         self.key = key
         self.skip_strategy = skip_strategy
         self.greedy_per_start = greedy_per_start
+        self.order_column = order_column
 
     def with_skip_strategy(self, strategy: str) -> "PatternStream":
         return PatternStream(self.stream, self.pattern, self.key, strategy,
-                             self.greedy_per_start)
+                             self.greedy_per_start, self.order_column)
 
     def _build(self, select_fn, out_schema: Schema, flat: bool):
         stages = self.pattern.compile()
@@ -47,12 +49,14 @@ class PatternStream:
         key = self.key
         skip = self.skip_strategy
         greedy = self.greedy_per_start
+        order_col = self.order_column
         keyed = self.stream.key_by(key)
 
         def factory():
             return CepOperator(
                 NFA(stages, within, skip, greedy_per_start=greedy), key,
-                select_fn, out_schema, flat_select=flat)
+                select_fn, out_schema, flat_select=flat,
+                order_column=order_col)
 
         out = keyed._one_input("CepOperator", factory,
                                key_extractor=keyed.key_extractor)

@@ -300,6 +300,13 @@ class NFA:
                 return branches  # NOT pattern matched: path dies
             for pj in self._next_candidates(p.stage):
                 nxt = self._stage(pj)
+                # STRICT next stage: the event must IMMEDIATELY follow the
+                # last taken event — a partial that ignored anything since
+                # its last take cannot strict-proceed (this is what makes
+                # keeping the source partial alive after a proceed safe)
+                if (nxt.contiguity == STRICT
+                        and p.ignored_since_advance > 0):
+                    break
                 if nxt.matches(event.data, ctx):
                     emit_offer(replace(
                         p, stage=pj, count=1, taking=True,
@@ -319,16 +326,18 @@ class NFA:
                 new_taking = False  # consecutive(): loop broken, may proceed
             if cont == RELAXED and took:
                 ignore_ok = False
-            # waiting for next stage is always allowed once min met, unless
-            # the next stage is STRICT: then THIS event was its only
-            # candidate — if the stage didn't extend, the wait dies whether
-            # or not a proceed branch was spawned (the branch carries on;
-            # letting the source also linger would match the strict stage
-            # against a LATER, non-consecutive event)
+            # waiting for next stage is allowed once min met as long as the
+            # loop could still take later events (relaxed inner): a strict
+            # next stage is protected by the ignored_since_advance gate on
+            # proceed, so a kept partial can never strict-proceed across a
+            # gap. With a STRICT inner loop (consecutive / MATCH_RECOGNIZE)
+            # a miss ends the loop AND the wait: the next event can neither
+            # extend the loop nor strict-follow the last take.
             if p.count >= s.min_count:
                 nxts = self._next_candidates(p.stage)
                 if nxts and self._stage(nxts[0]).contiguity == STRICT \
-                        and not took:
+                        and not took and (cont == STRICT
+                                          or not proceeded):
                     ignore_ok = False
         else:
             if cont == STRICT and not took:

@@ -28,13 +28,18 @@ class CepOperator(OneInputOperator):
 
     def __init__(self, nfa: NFA, key_column: str,
                  select_fn: Callable[[Match], Any], out_schema: Schema,
-                 flat_select: bool = False, name: str = "Cep"):
+                 flat_select: bool = False, name: str = "Cep",
+                 order_column: str = None):
+        """``order_column`` sorts each watermark-fired buffer by that
+        column instead of event time (SQL MATCH_RECOGNIZE ORDER BY over a
+        non-time attribute); event-time firing is unchanged."""
         super().__init__(name)
         self.nfa = nfa
         self.key_column = key_column
         self.select_fn = select_fn
         self.out_schema = out_schema
         self.flat_select = flat_select
+        self.order_column = order_column
         self._seq = itertools.count()
         # kg -> key -> {"buffer": [Event], "partials": [_Partial]}
         self._state: dict[int, dict[Any, dict]] = {}
@@ -77,6 +82,20 @@ class CepOperator(OneInputOperator):
                         del kg_map[key]  # fully drained: free the key
                     continue
                 st["buffer"] = [e for e in st["buffer"] if e.ts > wm_ts]
+                if self.order_column is not None:
+                    # the declared ordering must BE the time attribute:
+                    # watermark firing only orders rows within one fire, so
+                    # any other column silently mis-orders across fires —
+                    # the reference restricts MATCH_RECOGNIZE ORDER BY to
+                    # the time attribute for the same reason. Loud > wrong.
+                    for e in ready:
+                        if e.data.get(self.order_column) != e.ts:
+                            raise ValueError(
+                                f"ORDER BY {self.order_column!r} is not the "
+                                "stream's time attribute (row value "
+                                f"{e.data.get(self.order_column)!r} != "
+                                f"event time {e.ts}); MATCH_RECOGNIZE "
+                                "requires ordering by the time attribute")
                 ready.sort(key=lambda e: (e.ts, e.seq))
                 partials = st["partials"]
                 for ev in ready:

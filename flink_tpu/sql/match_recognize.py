@@ -252,7 +252,8 @@ def plan_match_recognize(mr: MatchRecognize, stream, in_schema: Schema,
     skip = (SKIP_PAST_LAST_EVENT if mr.after_match == "SKIP PAST LAST ROW"
             else SKIP_TO_NEXT_ROW)
     ps = PatternStream(stream, pattern, mr.partition_by[0],
-                       skip_strategy=skip, greedy_per_start=True)
+                       skip_strategy=skip, greedy_per_start=True,
+                       order_column=mr.order_by)
     out = ps.select(_measure_fn(mr.measures, mr.partition_by), out_schema)
     out._sql_schema = out_schema
     return out

@@ -266,7 +266,8 @@ class _Parser:
                 inner.alias = alias
             return inner
         k, v = self.peek()
-        if k == "id" and v.upper() in ("TUMBLE", "HOP", "CUMULATE"):
+        if k == "id" and v.upper() in ("TUMBLE", "HOP", "CUMULATE",
+                               "SESSION"):
             return self.window_tvf()
         if k != "id":
             raise SqlError(f"expected table name, got {v!r}")
@@ -278,7 +279,7 @@ class _Parser:
     def from_clause_inner(self) -> FromClause:
         if self.at_kw("SELECT"):
             return self.parse_select()
-        if self.at_kw("TUMBLE", "HOP", "CUMULATE"):
+        if self.at_kw("TUMBLE", "HOP", "CUMULATE", "SESSION"):
             return self.window_tvf()
         if self.at_kw("TABLE"):
             self.next()
@@ -324,7 +325,13 @@ class _Parser:
             slide, size = first, second
         self.expect_op(")")
         self.maybe_alias()
-        if kind == "TUMBLE":
+        if kind in ("TUMBLE", "SESSION"):
+            if slide is not None:
+                raise SqlError(
+                    f"{kind} takes exactly one INTERVAL "
+                    f"({'the gap' if kind == 'SESSION' else 'the size'}); "
+                    "two intervals are HOP/CUMULATE syntax")
+            # SESSION's single interval is the gap (reference SESSION TVF)
             return WindowTVF(kind, TableRef(tname), time_col, size)
         return WindowTVF(kind, TableRef(tname), time_col, size, slide)
 
@@ -404,7 +411,7 @@ class _Parser:
             if isinstance(e, Column) and e.table is not None \
                     and e.table not in known:
                 raise SqlError(
-                    f"MEASURES references unknown pattern variable "
+                    f"MEASURES/DEFINE references unknown pattern variable "
                     f"{e.table!r} (pattern: {sorted(known)})")
             for attr in ("left", "right", "operand"):
                 sub = getattr(e, attr, None)
@@ -421,6 +428,8 @@ class _Parser:
 
         for m_expr, _alias in measures:
             check_vars(m_expr)
+        for d_expr in defines.values():
+            check_vars(d_expr)
         return MatchRecognize(table, partition_by, order_by, measures,
                               pattern, defines, after, within_ms, alias)
 

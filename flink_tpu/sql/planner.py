@@ -503,6 +503,12 @@ class _Planner:
             assigner = TumblingEventTimeWindows.of(tvf.size_ms)
         elif tvf.kind == "HOP":
             assigner = SlidingEventTimeWindows.of(tvf.size_ms, tvf.slide_ms)
+        elif tvf.kind == "SESSION":
+            # merging windows: always the host WindowOperator path
+            # (sessions resist the fixed-pane device layout; reference
+            # likewise runs them in the generic WindowOperator)
+            from ..window import EventTimeSessionWindows
+            assigner = EventTimeSessionWindows.with_gap(tvf.size_ms)
         else:
             raise PlanError(f"{tvf.kind} windows not supported yet")
         keyed = ds.key_by(key_names[0])

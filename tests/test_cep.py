@@ -225,3 +225,39 @@ def test_cep_end_to_end():
                    m["end"][0]["v"]), out_schema)
     rows_out = out.execute_and_collect("cep")
     assert rows_out == [(1, 1, 2)]
+
+
+def test_relaxed_loop_with_strict_next_keeps_extending():
+    """one_or_more() + next(): the loop may ignore a mid-stream B, take a
+    later A, and strict-proceed after it (review counterexample — the
+    A=[1,1],B=[2] match must survive)."""
+    from flink_tpu.cep.nfa import Event
+
+    pat = (Pattern.begin("A").where(lambda e: e["p"] == 1).one_or_more()
+           .next("B").where(lambda e: e["p"] == 2))
+    nfa = NFA(pat.compile())
+    partials, matches = [], []
+    for seq, p in enumerate([1, 2, 1, 2]):
+        partials, ms = nfa.advance(partials, Event(seq, seq * 1000,
+                                                   {"p": p}))
+        matches += ms
+    shapes = sorted((len(m.events["A"]), len(m.events["B"]))
+                    for m in matches)
+    assert (2, 1) in shapes
+
+
+def test_strict_next_cannot_cross_an_ignored_event():
+    """next() means IMMEDIATELY after the last taken event: a kept partial
+    that ignored an event cannot strict-proceed later ([1,2,2] has exactly
+    one match, not a phantom second)."""
+    from flink_tpu.cep.nfa import Event
+
+    pat = (Pattern.begin("A").where(lambda e: e["p"] == 1).one_or_more()
+           .next("B").where(lambda e: e["p"] == 2))
+    nfa = NFA(pat.compile())
+    partials, matches = [], []
+    for seq, p in enumerate([1, 2, 2]):
+        partials, ms = nfa.advance(partials, Event(seq, seq * 1000,
+                                                   {"p": p}))
+        matches += ms
+    assert len(matches) == 1

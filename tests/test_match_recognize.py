@@ -212,3 +212,34 @@ def test_measures_unknown_variable_rejected_at_parse():
         parse("SELECT * FROM t MATCH_RECOGNIZE (PARTITION BY k ORDER BY ts "
               "MEASURES Z.price AS zp PATTERN (A B) "
               "DEFINE B AS B.price < A.price)")
+
+
+def test_order_by_non_time_column_rejected_loudly():
+    """ORDER BY must be the time attribute (reference restriction):
+    watermark firing only orders within one fire, so any other column
+    would silently mis-order — the operator raises instead (review: it
+    used to be silently ignored)."""
+    rows = [(1, 30, 1000), (1, 10, 2000), (1, 20, 3000)]
+    t = _t_env(rows)
+    with pytest.raises(Exception, match="time attribute"):
+        t.execute_sql("""
+            SELECT * FROM ticks MATCH_RECOGNIZE (
+                PARTITION BY sym ORDER BY price
+                MEASURES A.price AS a_p, B.price AS b_p
+                PATTERN (A B)
+                DEFINE B AS B.price > A.price
+            )""").collect_final()
+
+
+def test_two_intervals_rejected_for_session_and_tumble():
+    for kind in ("SESSION", "TUMBLE"):
+        with pytest.raises(SqlError, match="exactly one INTERVAL"):
+            parse(f"SELECT * FROM {kind}(TABLE t, DESCRIPTOR(ts), "
+                  "INTERVAL '1' SECOND, INTERVAL '5' SECOND)")
+
+
+def test_define_unknown_variable_rejected_at_parse():
+    with pytest.raises(SqlError, match="unknown pattern"):
+        parse("SELECT * FROM t MATCH_RECOGNIZE (PARTITION BY k ORDER BY ts "
+              "MEASURES A.v AS x PATTERN (A B) "
+              "DEFINE B AS B.v > Z.v)")

@@ -251,3 +251,36 @@ def test_missing_table_lists_known_names():
                       "WITH ('connector'='datagen')")
     with pytest.raises(Exception, match="known"):
         t_env.execute_sql("SELECT * FROM unknown")
+
+
+def test_session_window_tvf():
+    """SESSION TVF (reference 1.19 session TVF): gap-separated bursts per
+    key collapse into merged session windows on the host WindowOperator."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.records import Schema
+    from flink_tpu.sql import TableEnvironment as TE
+
+    schema = Schema([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+    rows = [
+        # key 1: burst of 3 (0..2s), 10s quiet, burst of 2 (13..14s)
+        (1, 1, 0), (1, 1, 1000), (1, 1, 2000),
+        (1, 1, 13_000), (1, 1, 14_000),
+        # key 2: single burst
+        (2, 1, 5000), (2, 1, 6000),
+    ]
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    t = TE(env)
+    ds = env.from_collection(rows, schema, timestamps=[r[2] for r in rows])
+    t.create_temporary_view("clicks", ds, schema)
+    got = t.execute_sql("""
+        SELECT k, window_start, COUNT(*) c FROM
+        SESSION(TABLE clicks, DESCRIPTOR(ts), INTERVAL '5' SECOND)
+        GROUP BY k, window_start""").collect_final()
+    by_key = {}
+    for k, ws, c in got:
+        by_key.setdefault(k, []).append((ws, c))
+    assert sorted(by_key[1]) == [(0, 3), (13_000, 2)]
+    assert by_key[2] == [(5000, 2)]
