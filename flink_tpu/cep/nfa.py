@@ -303,10 +303,14 @@ class NFA:
                 # STRICT next stage: the event must IMMEDIATELY follow the
                 # last taken event — a partial that ignored anything since
                 # its last take cannot strict-proceed (this is what makes
-                # keeping the source partial alive after a proceed safe)
+                # keeping the source partial alive after a proceed safe).
+                # Only THIS candidate is blocked: a later optional-skip
+                # candidate may be RELAXED and still reachable.
                 if (nxt.contiguity == STRICT
                         and p.ignored_since_advance > 0):
-                    break
+                    if nxt.optional:
+                        continue
+                    break  # a required strict stage blocks everything after
                 if nxt.matches(event.data, ctx):
                     emit_offer(replace(
                         p, stage=pj, count=1, taking=True,
@@ -335,9 +339,14 @@ class NFA:
             # extend the loop nor strict-follow the last take.
             if p.count >= s.min_count:
                 nxts = self._next_candidates(p.stage)
-                if nxts and self._stage(nxts[0]).contiguity == STRICT \
-                        and not took and (cont == STRICT
-                                          or not proceeded):
+                # only candidates still REACHABLE after this ignore matter:
+                # strict candidates die once anything was ignored; a
+                # relaxed candidate behind optional strict ones keeps the
+                # wait alive (followed_by's skip-till-next semantics)
+                all_strict = nxts and all(
+                    self._stage(j).contiguity == STRICT for j in nxts)
+                if all_strict and not took and (cont == STRICT
+                                                or not proceeded):
                     ignore_ok = False
         else:
             if cont == STRICT and not took:

@@ -50,8 +50,14 @@ class OperatorContext:
         return key_group_range_for_operator(
             self.max_parallelism, self.parallelism, self.subtask_index)
 
-    def create_keyed_backend(self, **kwargs) -> KeyedStateBackend:
-        name = self.config.get(StateOptions.BACKEND)
+    def create_keyed_backend(self, name: str = None,
+                             **kwargs) -> KeyedStateBackend:
+        """``name`` overrides the configured backend — operators whose
+        state shapes a partial backend cannot hold (e.g. the host
+        WindowOperator's per-window aggregating state on the tpu value
+        plane) pin the backend that can."""
+        if name is None:
+            name = self.config.get(StateOptions.BACKEND)
         backend = create_backend(name, self.key_group_range,
                                  self.max_parallelism, config=self.config,
                                  **kwargs)

@@ -111,7 +111,16 @@ class WindowOperator(OneInputOperator):
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         super().setup(ctx, output)
-        self._backend = ctx.create_keyed_backend()
+        # per-(key, window) namespaced list/aggregating state: fall back to
+        # the heap backend only when the CONFIGURED backend is a partial
+        # one that cannot hold these shapes (tpu value plane) — a full
+        # backend like changelog keeps its durability semantics
+        from ...core.config import StateOptions
+        from ...state.backend import backend_supports_general_state
+        configured = ctx.config.get(StateOptions.BACKEND)
+        self._backend = ctx.create_keyed_backend(
+            name=None if backend_supports_general_state(configured)
+            else "hashmap")
         self._timers = InternalTimerService(
             ctx.key_group_range, ctx.max_parallelism,
             on_event_time=self._on_event_time,

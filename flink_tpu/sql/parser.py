@@ -333,6 +333,10 @@ class _Parser:
                     "two intervals are HOP/CUMULATE syntax")
             # SESSION's single interval is the gap (reference SESSION TVF)
             return WindowTVF(kind, TableRef(tname), time_col, size)
+        if slide is None:
+            raise SqlError(
+                f"{kind} takes two INTERVALs "
+                f"({'slide, size' if kind == 'HOP' else 'step, size'})")
         return WindowTVF(kind, TableRef(tname), time_col, size, slide)
 
     def match_recognize(self, table: TableRef) -> MatchRecognize:

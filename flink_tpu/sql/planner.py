@@ -503,6 +503,10 @@ class _Planner:
             assigner = TumblingEventTimeWindows.of(tvf.size_ms)
         elif tvf.kind == "HOP":
             assigner = SlidingEventTimeWindows.of(tvf.size_ms, tvf.slide_ms)
+        elif tvf.kind == "CUMULATE":
+            from ..window import CumulateWindows
+            # parser: CUMULATE(..., INTERVAL step, INTERVAL size)
+            assigner = CumulateWindows.of(tvf.size_ms, tvf.slide_ms)
         elif tvf.kind == "SESSION":
             # merging windows: always the host WindowOperator path
             # (sessions resist the fixed-pane device layout; reference
@@ -520,6 +524,7 @@ class _Planner:
         key_field = pre_schema.field(key_names[0])
         from ..core.config import StateOptions
         use_device = (self.env.config.get(StateOptions.BACKEND) == "tpu"
+                      and tvf.kind in ("TUMBLE", "HOP")
                       and key_field.is_numeric
                       and np.issubdtype(np.dtype(key_field.dtype),
                                         np.integer)

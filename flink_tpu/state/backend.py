@@ -205,6 +205,18 @@ def register_backend(name: str, factory: StateBackendFactory) -> None:
     _BACKENDS[name] = factory
 
 
+def backend_supports_general_state(name: str) -> bool:
+    """Whether the named backend holds arbitrary namespaced list/
+    aggregating state (PARTIAL backends like the tpu value plane declare
+    SUPPORTS_GENERAL_STATE = False; operators needing general shapes fall
+    back to hashmap). Unknown/plugin names are assumed capable."""
+    if name not in _BACKENDS and name in _LAZY_BACKENDS:
+        import importlib
+        importlib.import_module(_LAZY_BACKENDS[name])
+    cls = _BACKENDS.get(name)
+    return getattr(cls, "SUPPORTS_GENERAL_STATE", True) if cls else True
+
+
 # built-in backends whose modules load on first use (the reference's
 # StateBackendLoader factory-class lookup, StateBackendLoader.java:113 —
 # the RocksDB backend is found by class name the same way)

@@ -134,6 +134,10 @@ class _ArrayState:
 
 
 class TpuKeyedStateBackend(KeyedStateBackend):
+    # the row plane is ValueState-only: operators needing namespaced list/
+    # aggregating state (host WindowOperator) must fall back to hashmap
+    SUPPORTS_GENERAL_STATE = False
+
     def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
                  capacity: int = 1 << 16, config=None,
                  defer_overflow: bool = False,

@@ -1,7 +1,7 @@
 """Windowing: assigners, triggers, evictors (SURVEY.md §2.5 WindowOperator row)."""
 
 from .assigners import (  # noqa: F401
-    EventTimeSessionWindows, GlobalWindow, GlobalWindows,
+    CumulateWindows, EventTimeSessionWindows, GlobalWindow, GlobalWindows,
     SlidingEventTimeWindows, SlidingProcessingTimeWindows, TimeWindow,
     TumblingEventTimeWindows, TumblingProcessingTimeWindows, WindowAssigner,
 )

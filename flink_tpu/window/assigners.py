@@ -25,7 +25,8 @@ from ..core.records import MAX_TIMESTAMP
 __all__ = [
     "TimeWindow", "GlobalWindow", "WindowAssigner", "TumblingEventTimeWindows",
     "TumblingProcessingTimeWindows", "SlidingEventTimeWindows",
-    "SlidingProcessingTimeWindows", "EventTimeSessionWindows", "GlobalWindows",
+    "SlidingProcessingTimeWindows", "CumulateWindows",
+    "EventTimeSessionWindows", "GlobalWindows",
 ]
 
 
@@ -168,6 +169,59 @@ class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
     def of(size_ms: int, slide_ms: int,
            offset_ms: int = 0) -> "SlidingProcessingTimeWindows":
         return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+@dataclass(frozen=True)
+class CumulateWindows(WindowAssigner):
+    """Cumulative (expanding) windows (reference CUMULATE TVF /
+    CumulativeWindowSpec): within each ``size`` base window, windows
+    [base, base + k*step) fire at every step until the base window closes.
+    Decomposes into ``step`` panes — each pane contributes to every
+    expanding window of its base that ends at or after it. NOTE: windows
+    span a VARIABLE number of panes (1..size/step), which the device fire
+    program's fixed panes-per-window model cannot express — cumulate runs
+    on the host WindowOperator (device_window.py rejects it; the planner
+    routes around it)."""
+
+    size: int
+    step: int
+    offset: int = 0
+
+    def __post_init__(self):
+        if self.size % self.step != 0:
+            raise ValueError(
+                f"CUMULATE size ({self.size}) must be a multiple of the "
+                f"step ({self.step})")
+
+    @staticmethod
+    def of(size_ms: int, step_ms: int,
+           offset_ms: int = 0) -> "CumulateWindows":
+        return CumulateWindows(size_ms, step_ms, offset_ms)
+
+    def _base(self, timestamp) -> int:
+        return int(_window_start(np.int64(timestamp), self.size,
+                                 self.offset))
+
+    def assign_windows(self, timestamp: int):
+        base = self._base(timestamp)
+        n = self.size // self.step
+        k_from = (timestamp - base) // self.step + 1
+        return [TimeWindow(base, base + k * self.step)
+                for k in range(k_from, n + 1)]
+
+    def assign_batch(self, timestamps: np.ndarray) -> np.ndarray:
+        return _window_start(timestamps, self.step, self.offset)
+
+    @property
+    def pane_size(self) -> int:
+        return self.step
+
+    def windows_for_pane(self, pane_start: int):
+        base = self._base(pane_start)
+        n = self.size // self.step
+        k_from = (pane_start - base) // self.step + 1
+        return [TimeWindow(base, base + k * self.step)
+                for k in range(k_from, n + 1)]
 
 
 @dataclass(frozen=True)

@@ -261,3 +261,27 @@ def test_strict_next_cannot_cross_an_ignored_event():
                                                    {"p": p}))
         matches += ms
     assert len(matches) == 1
+
+
+def test_optional_strict_then_relaxed_survives_a_gap():
+    """A+ next(B?) followed_by(C): an unmatched middle event must not kill
+    the path — C is RELAXED and still reachable (review counterexample:
+    [A, X, C] matched nothing while [A, C] matched)."""
+    from flink_tpu.cep.nfa import Event
+
+    def build():
+        return NFA((Pattern.begin("A").where(lambda e: e["t"] == "A")
+                    .one_or_more()
+                    .next("B").where(lambda e: e["t"] == "B").optional()
+                    .followed_by("C").where(lambda e: e["t"] == "C"))
+                   .compile())
+
+    for seq_types, expect in ([["A", "C"], 1], [["A", "X", "C"], 1],
+                              [["A", "B", "C"], 1]):
+        nfa = build()
+        partials, matches = [], []
+        for seq, t in enumerate(seq_types):
+            partials, ms = nfa.advance(
+                partials, Event(seq, seq * 1000, {"t": t}))
+            matches += ms
+        assert len(matches) >= expect, (seq_types, len(matches))

@@ -284,3 +284,82 @@ def test_session_window_tvf():
         by_key.setdefault(k, []).append((ws, c))
     assert sorted(by_key[1]) == [(0, 3), (13_000, 2)]
     assert by_key[2] == [(5000, 2)]
+
+
+def test_cumulate_window_tvf():
+    """CUMULATE TVF: expanding windows fire every step within the base
+    window; counts accumulate (reference CumulateWindowSpec)."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.records import Schema
+    from flink_tpu.sql import TableEnvironment as TE
+
+    schema = Schema([("k", np.int64), ("ts", np.int64)])
+    # 4 events in [0, 4s): one per second; base window 4s, step 1s
+    rows = [(1, 0), (1, 1000), (1, 2000), (1, 3000)]
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    t = TE(env)
+    ds = env.from_collection(rows, schema, timestamps=[r[1] for r in rows])
+    t.create_temporary_view("ev", ds, schema)
+    got = t.execute_sql("""
+        SELECT k, window_end, COUNT(*) c FROM
+        CUMULATE(TABLE ev, DESCRIPTOR(ts), INTERVAL '1' SECOND,
+                 INTERVAL '4' SECOND)
+        GROUP BY k, window_end""").collect_final()
+    by_end = {int(we): int(c) for _, we, c in got}
+    assert by_end == {1000: 1, 2000: 2, 3000: 3, 4000: 4}
+
+
+def test_cumulate_assigner_unit():
+    from flink_tpu.window import CumulateWindows, TimeWindow
+
+    a = CumulateWindows.of(4000, 1000)
+    assert a.assign_windows(0) == [TimeWindow(0, 1000), TimeWindow(0, 2000),
+                                   TimeWindow(0, 3000), TimeWindow(0, 4000)]
+    assert a.assign_windows(2500) == [TimeWindow(0, 3000),
+                                      TimeWindow(0, 4000)]
+    assert a.windows_for_pane(2000) == [TimeWindow(0, 3000),
+                                        TimeWindow(0, 4000)]
+    assert a.pane_size == 1000
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="multiple"):
+        CumulateWindows.of(4000, 1500)
+
+
+def test_cumulate_on_tpu_backend_falls_back_to_host():
+    """CUMULATE + tpu backend: the planner routes to the host
+    WindowOperator (the device fire program assumes fixed panes/window);
+    results identical to the heap run."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.config import StateOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.sql import TableEnvironment as TE
+
+    schema = Schema([("k", np.int64), ("ts", np.int64)])
+    rows = [(1, 0), (1, 1000), (1, 2000), (1, 3000)]
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    env.config.set(StateOptions.BACKEND, "tpu")
+    t = TE(env)
+    ds = env.from_collection(rows, schema, timestamps=[r[1] for r in rows])
+    t.create_temporary_view("ev", ds, schema)
+    got = t.execute_sql("""
+        SELECT k, window_end, COUNT(*) c FROM
+        CUMULATE(TABLE ev, DESCRIPTOR(ts), INTERVAL '1' SECOND,
+                 INTERVAL '4' SECOND)
+        GROUP BY k, window_end""").collect_final()
+    assert {int(we): int(c) for _, we, c in got} \
+        == {1000: 1, 2000: 2, 3000: 3, 4000: 4}
+
+
+def test_hop_cumulate_require_two_intervals():
+    from flink_tpu.sql.parser import parse
+
+    for kind in ("HOP", "CUMULATE"):
+        with pytest.raises(SqlError, match="two INTERVALs"):
+            parse(f"SELECT * FROM {kind}(TABLE t, DESCRIPTOR(ts), "
+                  "INTERVAL '5' SECOND)")
