@@ -550,11 +550,16 @@ class WindowedStream:
         custom trigger/evictor/lateness is attached. Falls back to the host
         WindowOperator otherwise — outputs are identical (parity-tested)."""
         from ..core.config import StateOptions
+        from ..window.assigners import CumulateWindows
         cfg = self.keyed.env.config
         if (cfg.get(StateOptions.BACKEND) != "tpu"
                 or not isinstance(self.keyed.key_spec, str)
                 or not isinstance(field, (str, type(None)))
                 or self.assigner.pane_size is None
+                # cumulate panes exist but windows span a VARIABLE number
+                # of them — the device/mesh fire programs assume fixed
+                # panes-per-window; host WindowOperator handles cumulate
+                or isinstance(self.assigner, CumulateWindows)
                 or self._trigger is not None or self._evictor is not None
                 or self._lateness != 0 or self._late_tag is not None):
             return None

@@ -75,6 +75,30 @@ def _cmd_savepoint_info(args) -> int:
     return 0
 
 
+def _cmd_list(args) -> int:
+    from .cluster.dispatcher import ClusterClient
+
+    for job in ClusterClient(args.target).list_jobs():
+        print(f"{job['job_id']}  {job['state']:<10} {job['name']}")
+    return 0
+
+
+def _cmd_cancel(args) -> int:
+    from .cluster.dispatcher import ClusterClient
+
+    ClusterClient(args.target).cancel(args.job_id)
+    print(f"cancelled {args.job_id}")
+    return 0
+
+
+def _cmd_savepoint(args) -> int:
+    from .cluster.dispatcher import ClusterClient
+
+    sp = ClusterClient(args.target).trigger_savepoint(args.job_id)
+    print(f"savepoint {sp['id']} path={sp.get('external_path')}")
+    return 0
+
+
 def _cmd_cluster(args) -> int:
     import time
 
@@ -115,6 +139,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     cluster.add_argument("--host", default="127.0.0.1")
     cluster.add_argument("--archive-dir", default="")
     cluster.set_defaults(fn=_cmd_cluster)
+
+    lst = sub.add_parser("list", help="list jobs on a session cluster")
+    lst.add_argument("--target", required=True, help="host:port")
+    lst.set_defaults(fn=_cmd_list)
+
+    cancel = sub.add_parser("cancel", help="cancel a job on a cluster")
+    cancel.add_argument("job_id")
+    cancel.add_argument("--target", required=True)
+    cancel.set_defaults(fn=_cmd_cancel)
+
+    sp = sub.add_parser("savepoint",
+                        help="trigger a savepoint on a running job")
+    sp.add_argument("job_id")
+    sp.add_argument("--target", required=True)
+    sp.set_defaults(fn=_cmd_savepoint)
 
     spi = sub.add_parser("savepoint-info", help="inspect a savepoint")
     spi.add_argument("path")

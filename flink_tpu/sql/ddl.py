@@ -119,6 +119,11 @@ class InsertStmt:
     select: SelectStmt
 
 
+@dataclass
+class ExplainStmt:
+    select: SelectStmt
+
+
 # -- DDL parser -------------------------------------------------------------
 
 class _DdlParser:
@@ -293,6 +298,12 @@ def parse_statement(sql: str):
         return DescribeStmt(p.ident())
     if head == "INSERT":
         return p.parse_insert()
+    if head == "EXPLAIN":
+        parts = stripped.split(None, 1)
+        rest = parts[1] if len(parts) > 1 else ""
+        if not rest.strip():
+            raise SqlError("EXPLAIN: missing statement")
+        return ExplainStmt(parse(rest))
     return parse(stripped)
 
 

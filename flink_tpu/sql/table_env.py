@@ -21,7 +21,7 @@ from ..core.records import RecordBatch, Schema
 from . import rowkind as rk
 from .ddl import (
     Catalog, CatalogTable, CreateTableStmt, CreateViewStmt, DescribeStmt,
-    DropStmt, InsertStmt, ShowTablesStmt, dtype_to_sql_type,
+    DropStmt, ExplainStmt, InsertStmt, ShowTablesStmt, dtype_to_sql_type,
     instantiate_sink, instantiate_source, parse_statement, sql_type_to_dtype,
 )
 from .parser import parse
@@ -219,6 +219,8 @@ class TableEnvironment:
                 Schema([("name", object), ("type", object)]),
                 [(f.name, dtype_to_sql_type(f.dtype))
                  for f in schema.fields])
+        if isinstance(stmt, ExplainStmt):
+            return self._explain(stmt)
         if isinstance(stmt, InsertStmt):
             return self._execute_insert(stmt, timeout)
         # plain query
@@ -284,6 +286,26 @@ class TableEnvironment:
         # spec-backed queries, the user's for bound streams)
         table.stream.env.execute("sql-query", timeout=timeout)
         return TableResult(table.schema, sink.rows)
+
+    def _explain(self, stmt: ExplainStmt) -> TableResult:
+        """EXPLAIN <query>: plan without executing and render the physical
+        JobGraph — chained vertices, parallelism, exchanges (reference
+        TableEnvironment.explainSql's optimized execution plan)."""
+        env = self._fresh_env()
+        stream = plan(stmt.select, self._make_resolver(env), env)
+        from ..connectors.core import CollectSink
+        stream.add_sink(CollectSink(), "Explain")
+        jg = env.get_job_graph("explain")
+        lines = ["== Physical Execution Plan =="]
+        for vid, v in jg.vertices.items():
+            lines.append(f"{vid}: {v.name} (parallelism={v.parallelism}, "
+                         f"max={v.max_parallelism})")
+            for e in jg.in_edges(vid):
+                tag = " [feedback]" if e.feedback else ""
+                lines.append(f"  <- {e.source_vertex} "
+                             f"[{e.partitioner_name}]{tag}")
+        return TableResult(Schema([("plan", object)]),
+                           [(ln,) for ln in lines])
 
     @staticmethod
     def _ok() -> "TableResult":

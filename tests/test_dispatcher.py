@@ -251,3 +251,29 @@ def test_savepoint_on_iteration_job_refused():
             coord.trigger_savepoint(timeout=5.0)
     finally:
         job.cancel()
+
+
+def test_cli_list_cancel_savepoint_against_cluster(tmp_path, capsys):
+    from flink_tpu.cli import main as cli_main
+
+    d = Dispatcher(port=0)
+    d.start()
+    try:
+        env = _build_env(str(tmp_path / "c.csv"), n=5_000_000, rate=5000.0)
+        env.config.set(CheckpointingOptions.INTERVAL, 0.1)
+        job_id = ClusterClient(d.address).submit(env, name="cli-job")
+        deadline = time.time() + 10
+        while (ClusterClient(d.address).status(job_id)["state"] != "RUNNING"
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert cli_main(["list", "--target", d.address]) == 0
+        out = capsys.readouterr().out
+        assert job_id in out and "cli-job" in out
+        time.sleep(0.3)
+        assert cli_main(["savepoint", job_id, "--target", d.address]) == 0
+        assert "savepoint" in capsys.readouterr().out
+        assert cli_main(["cancel", job_id, "--target", d.address]) == 0
+        assert ClusterClient(d.address).wait(job_id, 30.0)["state"] \
+            == "CANCELLED"
+    finally:
+        d.stop()

@@ -363,3 +363,58 @@ def test_hop_cumulate_require_two_intervals():
         with pytest.raises(SqlError, match="two INTERVALs"):
             parse(f"SELECT * FROM {kind}(TABLE t, DESCRIPTOR(ts), "
                   "INTERVAL '5' SECOND)")
+
+
+def test_explain_renders_physical_plan_without_executing():
+    t_env = TableEnvironment()
+    _mk_bids(t_env, rows=1_000_000_000)   # would take forever if executed
+    plan_rows = t_env.execute_sql(
+        "EXPLAIN SELECT auction, COUNT(*) FROM bids GROUP BY auction",
+        timeout=10.0).collect()
+    text = "\n".join(r[0] for r in plan_rows)
+    assert "Physical Execution Plan" in text
+    assert "parallelism=" in text
+    assert "key_group" in text or "hash" in text or "<-" in text
+
+
+def test_explain_missing_statement():
+    t_env = TableEnvironment()
+    with pytest.raises(SqlError, match="missing"):
+        t_env.execute_sql("EXPLAIN")
+
+
+def test_cumulate_datastream_on_tpu_backend_falls_back():
+    """DataStream-level cumulate + tpu backend (and mesh config) routes to
+    the host operator instead of lowering to device/mesh fire programs
+    whose fixed panes-per-window would be silently wrong."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.config import StateOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.window import CumulateWindows
+
+    schema = Schema([("k", np.int64), ("v", np.int64)])
+    rows = [(1, 1), (1, 1), (1, 1), (1, 1)]
+    ts = [0, 1000, 2000, 3000]
+    for mesh in (0, 4):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(1)
+        env.config.set(StateOptions.BACKEND, "tpu")
+        if mesh:
+            env.config.set(StateOptions.MESH_DEVICES, mesh)
+        sink = CollectSink()
+        ds = env.from_collection(rows, schema, timestamps=ts)
+        (ds.key_by("k").window(CumulateWindows.of(4000, 1000))
+           .sum(1).add_sink(sink, "s"))
+        env.execute(f"cumulate-ds-{mesh}", timeout=60.0)
+        sums = sorted(r[-1] for r in sink.rows)
+        assert sums == [1, 2, 3, 4], (mesh, sink.rows)
+
+
+def test_explain_multiline_whitespace():
+    from flink_tpu.sql.ddl import parse_statement, ExplainStmt
+
+    stmt = parse_statement("EXPLAIN\nSELECT\n*\nFROM\nt")
+    assert isinstance(stmt, ExplainStmt)
