@@ -594,6 +594,17 @@ class WindowedStream:
         return self.keyed._one_input(name, factory, parallelism=par,
                                      key_extractor=self.keyed.key_extractor)
 
+    def _reject_variable_pane_assigner(self, which: str) -> None:
+        """The device and mesh fire programs assume a FIXED panes-per-
+        window (tumbling/sliding); cumulate windows span a variable pane
+        count and would silently aggregate with sliding semantics."""
+        from ..window.assigners import CumulateWindows
+        if isinstance(self.assigner, CumulateWindows):
+            raise ValueError(
+                f"cumulate windows cannot run on the {which} window "
+                "operator (variable panes per window); use the host "
+                "WindowOperator (.aggregate/.sum) or the SQL CUMULATE TVF")
+
     def device_aggregate(self, aggs, capacity: int = 1 << 16,
                          ring_size: int = 64,
                          emit_window_bounds: bool = True,
@@ -614,6 +625,7 @@ class WindowedStream:
         from ..runtime.operators.device_window import DeviceWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("device aggregation needs a column key")
+        self._reject_variable_pane_assigner("device")
         assigner = self.assigner
         key_col = self.keyed.key_spec
 
@@ -646,6 +658,7 @@ class WindowedStream:
         from ..runtime.operators.mesh_window import MeshWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("mesh aggregation needs a column key")
+        self._reject_variable_pane_assigner("mesh")
         assigner = self.assigner
         key_col = self.keyed.key_spec
 

@@ -300,10 +300,13 @@ def parse_statement(sql: str):
         return p.parse_insert()
     if head == "EXPLAIN":
         parts = stripped.split(None, 1)
-        rest = parts[1] if len(parts) > 1 else ""
-        if not rest.strip():
+        rest = parts[1].strip() if len(parts) > 1 else ""
+        if not rest:
             raise SqlError("EXPLAIN: missing statement")
-        return ExplainStmt(parse(rest))
+        inner = parse_statement(rest)
+        if not isinstance(inner, (SelectStmt, InsertStmt)):
+            raise SqlError("EXPLAIN supports queries and INSERT INTO")
+        return ExplainStmt(inner)
     return parse(stripped)
 
 

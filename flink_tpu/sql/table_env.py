@@ -288,14 +288,26 @@ class TableEnvironment:
         return TableResult(table.schema, sink.rows)
 
     def _explain(self, stmt: ExplainStmt) -> TableResult:
-        """EXPLAIN <query>: plan without executing and render the physical
-        JobGraph — chained vertices, parallelism, exchanges (reference
-        TableEnvironment.explainSql's optimized execution plan)."""
+        """EXPLAIN <query | INSERT>: plan without executing and render the
+        physical JobGraph — chained vertices, parallelism, exchanges
+        (reference TableEnvironment.explainSql). The graph is built
+        directly from the planned terminal transformation: nothing is
+        registered on the (possibly user-owned) environment, so EXPLAIN
+        never leaks sinks into a later execute()."""
         env = self._fresh_env()
-        stream = plan(stmt.select, self._make_resolver(env), env)
-        from ..connectors.core import CollectSink
-        stream.add_sink(CollectSink(), "Explain")
-        jg = env.get_job_graph("explain")
+        inner = stmt.select
+        sink_line = None
+        if isinstance(inner, InsertStmt):
+            target = self.catalog.get(inner.target)
+            if target is None:
+                raise PlanError(f"sink table {inner.target!r} not found")
+            sink_line = (f"sink: {inner.target} "
+                         f"[{target.options.get('connector')}]")
+            inner = inner.select
+        stream = plan(inner, self._make_resolver(env), env)
+        from ..graph.stream_graph import build_job_graph, build_stream_graph
+        sg = build_stream_graph([stream.transformation], env.config)
+        jg = build_job_graph(sg, env.config, "explain")
         lines = ["== Physical Execution Plan =="]
         for vid, v in jg.vertices.items():
             lines.append(f"{vid}: {v.name} (parallelism={v.parallelism}, "
@@ -304,6 +316,8 @@ class TableEnvironment:
                 tag = " [feedback]" if e.feedback else ""
                 lines.append(f"  <- {e.source_vertex} "
                              f"[{e.partitioner_name}]{tag}")
+        if sink_line:
+            lines.append(sink_line)
         return TableResult(Schema([("plan", object)]),
                            [(ln,) for ln in lines])
 

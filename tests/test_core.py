@@ -163,3 +163,22 @@ class TestWatermarks:
         b = RecordBatch.from_rows(s, [(5, 0), (9, 1)])
         b2 = ws.assign_timestamps(b)
         assert list(b2.timestamps) == [5, 9]
+
+
+def test_config_docs_generator_covers_all_options():
+    """Docs generate from the option definitions (reference
+    ConfigOptionsDocGenerator): every ConfigOption in every *Options class
+    appears exactly once."""
+    import inspect
+
+    from flink_tpu.core import config as cfg
+    from flink_tpu.core.config import ConfigOption
+    from flink_tpu.docs import generate_config_docs
+
+    text = generate_config_docs()
+    for name, cls in inspect.getmembers(cfg, inspect.isclass):
+        if not name.endswith("Options"):
+            continue
+        for attr, val in vars(cls).items():
+            if isinstance(val, ConfigOption):
+                assert text.count(f"| `{val.key}` |") == 1, val.key

@@ -418,3 +418,59 @@ def test_explain_multiline_whitespace():
 
     stmt = parse_statement("EXPLAIN\nSELECT\n*\nFROM\nt")
     assert isinstance(stmt, ExplainStmt)
+
+
+def test_device_and_mesh_aggregate_reject_cumulate():
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime.operators.device_window import AggSpec
+    from flink_tpu.window import CumulateWindows
+
+    schema = Schema([("k", np.int64), ("v", np.int64)])
+    for method in ("device_aggregate", "mesh_aggregate"):
+        env = StreamExecutionEnvironment()
+        ds = env.from_collection([(1, 1)], schema, timestamps=[0])
+        w = ds.key_by("k").window(CumulateWindows.of(4000, 1000))
+        with pytest.raises(ValueError, match="cumulate"):
+            getattr(w, method)([AggSpec("sum", "v")])
+
+
+def test_explain_does_not_pollute_bound_stream_env():
+    """EXPLAIN over a temporary view must not register sinks on the user's
+    env: the next execute() runs ONLY the user's pipeline."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.records import Schema
+
+    env = StreamExecutionEnvironment()
+    schema = Schema([("a", np.int64)])
+    ds = env.from_collection([(1,), (2,), (3,)], schema,
+                             timestamps=[0, 1, 2])
+    t_env = TableEnvironment(env)
+    t_env.create_temporary_view("v", ds, schema)
+    plan_text = "\n".join(
+        r[0] for r in t_env.execute_sql("EXPLAIN SELECT a FROM v "
+                                        "WHERE a > 1").collect())
+    assert "Physical Execution Plan" in plan_text
+    assert env._sinks == []               # nothing registered
+    sink = CollectSink()
+    ds.add_sink(sink, "user-sink")
+    env.execute("user-job", timeout=30.0)
+    assert sorted(r for r in sink.rows) == [1, 2, 3]
+
+
+def test_explain_insert_into():
+    t_env = TableEnvironment()
+    _mk_bids(t_env, rows=10)
+    t_env.execute_sql("""
+        CREATE TABLE esink (a BIGINT, p BIGINT) WITH (
+            'connector'='blackhole')""")
+    rows = t_env.execute_sql(
+        "EXPLAIN INSERT INTO esink SELECT auction, price FROM bids")
+    text = "\n".join(r[0] for r in rows.collect())
+    assert "sink: esink [blackhole]" in text
+    assert "Physical Execution Plan" in text
