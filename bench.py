@@ -103,9 +103,12 @@ def _find_ops(env, cls):
 
 
 def _n_panes(n_events: int) -> int:
-    """Panes sized so one source batch (= one watermark) advances well
-    under a pane: the open span stays inside the accumulator ring."""
-    return max(4, min(24, n_events // BATCH))
+    """Panes sized so the WHOLE stream's event-time span plus the sliding
+    window's 4-pane tail fits inside the RING-slot accumulator ring with
+    headroom: worst-case open span = n_panes + 4 must stay < RING even if
+    fire retirement lags ingest completely (slow chip / congested tunnel /
+    CPU fallback). RING-7 panes -> max open span RING-3."""
+    return max(4, min(RING - 7, n_events // BATCH))
 
 
 def _collect_stages(env) -> dict:

@@ -53,12 +53,16 @@ def generate_config_docs() -> str:
         doc = inspect.getdoc(cls)
         if doc:
             out.append("")
-            out.append(doc.split("\n")[0])
+            # first PARAGRAPH, whitespace-joined (a wrapped summary line
+            # must not truncate mid-sentence)
+            first_para = doc.split("\n\n")[0]
+            out.append(" ".join(first_para.split()))
         out.append("")
         out.append("| Key | Type | Default | Description |")
         out.append("|---|---|---|---|")
         for _attr, opt in sorted(opts, key=lambda kv: kv[1].key):
-            desc = " ".join(opt.description.split())
+            # '|' would split the markdown table cell
+            desc = " ".join(opt.description.split()).replace("|", "\\|")
             out.append(f"| `{opt.key}` | {_fmt_type(opt)} | "
                        f"{_fmt_default(opt)} | {desc} |")
         out.append("")

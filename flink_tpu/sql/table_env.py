@@ -228,10 +228,10 @@ class TableEnvironment:
         out = plan(stmt, self._make_resolver(env), env)
         return Table(self, out, out._sql_schema).execute(timeout)
 
-    def _execute_insert(self, stmt: InsertStmt,
-                        timeout: Optional[float]) -> TableResult:
-        """INSERT INTO sink_table SELECT ... (reference executeInternal
-        with a ModifyOperation -> DynamicTableSink)."""
+    def _validate_insert(self, stmt: InsertStmt, env) -> tuple:
+        """Shared by execution AND EXPLAIN, so EXPLAIN surfaces the same
+        errors the real INSERT would (target kind, changelog, arity).
+        Returns (target entry, planned stream)."""
         target = self.catalog.get(stmt.target)
         if target is None:
             raise PlanError(f"sink table {stmt.target!r} not found")
@@ -239,7 +239,6 @@ class TableEnvironment:
             raise PlanError(f"cannot INSERT INTO {target.kind} "
                             f"{stmt.target!r}; target must be a connector-"
                             f"backed table")
-        env = self._fresh_env()
         stream = plan(stmt.select, self._make_resolver(env), env)
         out_schema = stream._sql_schema
         if rk.ROWKIND_COLUMN in out_schema:
@@ -253,6 +252,15 @@ class TableEnvironment:
                 f"INSERT INTO {stmt.target}: query produces "
                 f"{len(out_schema)} columns, table has "
                 f"{len(target.schema)}")
+        return target, stream
+
+    def _execute_insert(self, stmt: InsertStmt,
+                        timeout: Optional[float]) -> TableResult:
+        """INSERT INTO sink_table SELECT ... (reference executeInternal
+        with a ModifyOperation -> DynamicTableSink)."""
+        env = self._fresh_env()
+        target, stream = self._validate_insert(stmt, env)
+        out_schema = stream._sql_schema
         # map query columns to the TARGET's names positionally (reference
         # maps insert columns by position): formats like json encode field
         # names, so aliased query outputs must be renamed before the sink
@@ -298,13 +306,13 @@ class TableEnvironment:
         inner = stmt.select
         sink_line = None
         if isinstance(inner, InsertStmt):
-            target = self.catalog.get(inner.target)
-            if target is None:
-                raise PlanError(f"sink table {inner.target!r} not found")
+            # same validation as execution: EXPLAIN must fail where the
+            # real INSERT would (view target, arity, retracting query)
+            target, stream = self._validate_insert(inner, env)
             sink_line = (f"sink: {inner.target} "
                          f"[{target.options.get('connector')}]")
-            inner = inner.select
-        stream = plan(inner, self._make_resolver(env), env)
+        else:
+            stream = plan(inner, self._make_resolver(env), env)
         from ..graph.stream_graph import build_job_graph, build_stream_graph
         sg = build_stream_graph([stream.transformation], env.config)
         jg = build_job_graph(sg, env.config, "explain")

@@ -182,3 +182,17 @@ def test_config_docs_generator_covers_all_options():
         for attr, val in vars(cls).items():
             if isinstance(val, ConfigOption):
                 assert text.count(f"| `{val.key}` |") == 1, val.key
+
+
+def test_committed_config_docs_are_fresh():
+    """The committed docs/CONFIG.md must equal the generator output — a
+    ConfigOption change without rerunning `python -m flink_tpu.docs`
+    fails here (the actual 'docs cannot drift' enforcement)."""
+    import os
+
+    from flink_tpu.docs import generate_config_docs
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "CONFIG.md")
+    with open(path) as f:
+        assert f.read() == generate_config_docs()

@@ -474,3 +474,16 @@ def test_explain_insert_into():
     text = "\n".join(r[0] for r in rows.collect())
     assert "sink: esink [blackhole]" in text
     assert "Physical Execution Plan" in text
+
+
+def test_explain_insert_surfaces_execution_errors():
+    t_env = TableEnvironment()
+    _mk_bids(t_env, rows=10)
+    t_env.execute_sql("CREATE VIEW vv AS SELECT auction FROM bids")
+    with pytest.raises(Exception, match="INSERT INTO view"):
+        t_env.execute_sql("EXPLAIN INSERT INTO vv SELECT auction FROM bids")
+    t_env.execute_sql("CREATE TABLE nsink (a BIGINT) WITH "
+                      "('connector'='blackhole')")
+    with pytest.raises(Exception, match="columns"):
+        t_env.execute_sql("EXPLAIN INSERT INTO nsink "
+                          "SELECT auction, price FROM bids")
