@@ -595,15 +595,8 @@ class WindowedStream:
                                      key_extractor=self.keyed.key_extractor)
 
     def _reject_variable_pane_assigner(self, which: str) -> None:
-        """The device and mesh fire programs assume a FIXED panes-per-
-        window (tumbling/sliding); cumulate windows span a variable pane
-        count and would silently aggregate with sliding semantics."""
-        from ..window.assigners import CumulateWindows
-        if isinstance(self.assigner, CumulateWindows):
-            raise ValueError(
-                f"cumulate windows cannot run on the {which} window "
-                "operator (variable panes per window); use the host "
-                "WindowOperator (.aggregate/.sum) or the SQL CUMULATE TVF")
+        from ..window.assigners import reject_variable_pane_assigner
+        reject_variable_pane_assigner(self.assigner, which)
 
     def device_aggregate(self, aggs, capacity: int = 1 << 16,
                          ring_size: int = 64,

@@ -390,7 +390,13 @@ class FsCheckpointStorage(CheckpointStorage):
         if dead:
             self._save_refs()
 
-    def load(self, path: str) -> CompletedCheckpoint:
+    def load(self, path: str,
+             resolve: bool = True) -> CompletedCheckpoint:
+        """``resolve=False`` returns the checkpoint with chunk REFS still
+        in place (metadata is fully usable: ids, uids, parallelism) —
+        callers that substitute some tasks' snapshots from elsewhere
+        (local recovery) resolve only the remainder via resolve_tasks,
+        skipping those tasks' chunk reads entirely."""
         meta = path if path.endswith("_metadata") else os.path.join(path,
                                                                     "_metadata")
         with open(meta, "rb") as f:
@@ -411,8 +417,20 @@ class FsCheckpointStorage(CheckpointStorage):
         chunk_dir = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(meta))),
             "chunks")
-        cp.task_snapshots = self._resolve(cp.task_snapshots, chunk_dir)
+        cp._chunk_dir = chunk_dir
+        if resolve:
+            cp.task_snapshots = self._resolve(cp.task_snapshots, chunk_dir)
         return cp
+
+    def resolve_tasks(self, cp: CompletedCheckpoint,
+                      skip: "set[str]" = frozenset()) -> None:
+        """Materialize chunk refs for every task NOT in ``skip`` (whose
+        snapshots the caller replaces; their chunks are never read)."""
+        chunk_dir = getattr(cp, "_chunk_dir", None)
+        cp.task_snapshots = {
+            tid: (snap if tid in skip
+                  else self._resolve(snap, chunk_dir))
+            for tid, snap in cp.task_snapshots.items()}
 
 
 _COMPRESSED_MAGIC = b"FTCK"   # format v1: compressed class-pickle (legacy)

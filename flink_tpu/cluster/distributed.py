@@ -39,7 +39,9 @@ from typing import Any, Optional
 
 from ..checkpoint.storage import CompletedCheckpoint, FsCheckpointStorage, \
     MemoryCheckpointStorage
-from ..core.config import CheckpointingOptions, Configuration, RuntimeOptions
+from ..core.config import (
+    CheckpointingOptions, Configuration, RuntimeOptions, StateOptions,
+)
 from .failover import restart_strategy_from_config
 from .resource_manager import SlotManager, build_schedule
 from ..graph.stream_graph import JobGraph
@@ -442,6 +444,15 @@ class DistributedHost:
         self._redeploying = threading.Event()
         self._pending_ckpts: dict[int, tuple[int, bool]] = {}
         self._intent_lock = threading.Lock()
+        # local recovery (reference TaskLocalStateStore /
+        # LocalRecoveryConfig): keep the snapshots THIS host acked so a
+        # failover restart restores surviving subtasks from the local copy
+        # instead of re-reading checkpoint storage; keyed state for
+        # RELOCATED subtasks still loads remotely. In-memory is the right
+        # scope here: survivors restart within the same process.
+        self._local_recovery = bool(config.get(StateOptions.LOCAL_RECOVERY))
+        self._local_snapshots: dict[int, dict[str, dict]] = {}  # cid -> map
+        self.local_restores = 0     # observability: tasks restored locally
         # control-socket sends originate from the heartbeat thread, the
         # checkpoint listener AND the run loop: serialize the frames
         self._ctrl_lock = threading.Lock()
@@ -680,11 +691,35 @@ class DistributedHost:
             if kind == "ack":
                 acks.setdefault(cid, {})[task_id] = payload
                 if cid in pending and len(acks[cid]) == pending[cid][0]:
+                    snaps = acks.pop(cid)
+                    if self._local_recovery:
+                        # stash a PICKLED copy: snapshot dicts share value
+                        # references with live state (heap lists keep
+                        # mutating after the barrier), and a local restore
+                        # must see barrier-time state, not future state.
+                        # Keyed by UID#sub — generated vertex ids are a
+                        # process-global counter and never comparable
+                        # across graphs (the same trap the coordinator's
+                        # ack canonicalization exists for)
+                        uid_of = self._uid_map()
+                        by_uid = {}
+                        for tid, snap in snaps.items():
+                            vid, sub = tid.rsplit("#", 1)
+                            by_uid[f"{uid_of.get(vid, vid)}#{sub}"] = snap
+                        self._local_snapshots[cid] = pickle.dumps(
+                            by_uid, protocol=pickle.HIGHEST_PROTOCOL)
+                        # safety cap only: real pruning happens on the
+                        # checkpoint_complete broadcast — pruning by ack
+                        # order could evict the copy for the latest
+                        # COMPLETED checkpoint under later acks whose
+                        # checkpoints never complete
+                        for old in sorted(self._local_snapshots)[:-8]:
+                            del self._local_snapshots[old]
                     self._ctrl_send({
                         "type": "ack", "host_id": self.host_id,
                         "checkpoint_id": cid,
                         "savepoint": pending[cid][1],
-                        "snapshots": acks.pop(cid)})
+                        "snapshots": snaps})
                     del pending[cid]
             else:
                 self._ctrl_send({"type": "decline",
@@ -731,6 +766,14 @@ class DistributedHost:
                         t.trigger_checkpoint(barrier)
                 elif msg["type"] == "checkpoint_complete":
                     cid = msg["checkpoint_id"]
+                    # prune local-recovery copies on COMPLETION (reference
+                    # confirms checkpoints before pruning local state):
+                    # everything older than the newest completed cid can
+                    # never be restored
+                    if self._local_recovery:
+                        for old in [c for c in self._local_snapshots
+                                    if c < cid]:
+                            del self._local_snapshots[old]
                     for t in self.job.tasks.values():
                         t.execute_in_mailbox(
                             lambda t=t, c=cid:
@@ -775,16 +818,57 @@ class DistributedHost:
     def _load_restore_map(self, intent: dict) -> Optional[dict]:
         """task_id -> snapshot for a restart order (checkpoint shipped
         inline for in-memory storage, loaded from shared storage by path
-        otherwise; None = restart from scratch)."""
+        otherwise; None = restart from scratch). With local recovery on,
+        tasks whose acked snapshot for this checkpoint id is still held
+        locally restore from the local copy — relocated subtasks (a dead
+        host's work moving here) still come from the checkpoint."""
         cp = intent.get("checkpoint")
         path = intent.get("checkpoint_path")
+        storage = None
         if cp is None and path:
-            cp = FsCheckpointStorage(
-                str(path).rsplit("/", 1)[0]).load(path)
+            storage = FsCheckpointStorage(str(path).rsplit("/", 1)[0])
+            # metadata only; chunk reads happen per task AFTER local
+            # substitution so locally-covered tasks never touch storage
+            cp = storage.load(path, resolve=False)
         if cp is None:
             return None
         from ..checkpoint.coordinator import build_restore_map
 
+        local_blob = (self._local_snapshots.get(cp.checkpoint_id)
+                      if self._local_recovery else None)
+        substituted: set = set()
+        if local_blob:
+            # substitute local ack copies at the INPUT of the restore
+            # mapping: build_restore_map transforms ack-shaped snapshots
+            # into restore-shaped entries (keyed merges, operator-state
+            # redistribution), so local copies must replace the
+            # checkpoint's task snapshots BEFORE that transformation, not
+            # its output. Matching runs through UID#sub (the stash key) ->
+            # the checkpoint's canonical vertex ids.
+            local = pickle.loads(local_blob)
+            uid_to_canonical = {uid: vid for vid, uid
+                                in (cp.vertex_uids or {}).items()}
+            snaps = dict(cp.task_snapshots)
+            for key, snap in local.items():
+                uid, sub = key.rsplit("#", 1)
+                cvid = uid_to_canonical.get(uid)
+                if cvid is not None and f"{cvid}#{sub}" in snaps:
+                    snaps[f"{cvid}#{sub}"] = snap
+                    substituted.add(f"{cvid}#{sub}")
+                    self.local_restores += 1
+            chunk_dir = getattr(cp, "_chunk_dir", None)
+            cp = CompletedCheckpoint(
+                checkpoint_id=cp.checkpoint_id, timestamp=cp.timestamp,
+                task_snapshots=snaps, is_savepoint=cp.is_savepoint,
+                vertex_parallelism=cp.vertex_parallelism,
+                vertex_uids=cp.vertex_uids,
+                external_path=cp.external_path)
+            cp._chunk_dir = chunk_dir
+        if storage is not None:
+            # materialize the rest (relocated subtasks etc.); substituted
+            # tasks skip their chunk reads — the actual I/O local recovery
+            # saves
+            storage.resolve_tasks(cp, skip=substituted)
         return build_restore_map(cp, self.jg)
 
     def run(self, peer_data_addrs: dict[int, tuple[str, int]],

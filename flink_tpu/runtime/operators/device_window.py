@@ -230,14 +230,8 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             raise ValueError(
                 "Device window operator needs a pane-decomposable assigner "
                 "(tumbling, or sliding with size % slide == 0)")
-        from ...window.assigners import CumulateWindows
-        if isinstance(assigner, CumulateWindows):
-            # cumulate windows span a VARIABLE number of panes (1..size/
-            # step); the device fire program assumes the fixed panes-per-
-            # window of tumbling/sliding — host WindowOperator handles them
-            raise ValueError(
-                "cumulate windows run on the host WindowOperator; the "
-                "device slice path covers tumbling/sliding")
+        from ...window.assigners import reject_variable_pane_assigner
+        reject_variable_pane_assigner(assigner, "device")
         self._assigner = assigner
         self._pane = int(pane)
         self._offset = int(getattr(assigner, "offset", 0))

@@ -88,14 +88,8 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             raise ValueError(
                 "Mesh window operator needs a pane-decomposable assigner "
                 "(tumbling, or sliding with size % slide == 0)")
-        from ...window.assigners import CumulateWindows
-        if isinstance(assigner, CumulateWindows):
-            # cumulate windows span a VARIABLE number of panes; the mesh
-            # fire program merges a fixed panes-per-window — host
-            # WindowOperator handles cumulate
-            raise ValueError(
-                "cumulate windows run on the host WindowOperator; the "
-                "mesh slice path covers tumbling/sliding")
+        from ...window.assigners import reject_variable_pane_assigner
+        reject_variable_pane_assigner(assigner, "mesh")
         self._assigner = assigner
         self._pane = int(pane)
         self._offset = int(getattr(assigner, "offset", 0))

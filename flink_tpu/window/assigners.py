@@ -26,6 +26,7 @@ __all__ = [
     "TimeWindow", "GlobalWindow", "WindowAssigner", "TumblingEventTimeWindows",
     "TumblingProcessingTimeWindows", "SlidingEventTimeWindows",
     "SlidingProcessingTimeWindows", "CumulateWindows",
+    "reject_variable_pane_assigner",
     "EventTimeSessionWindows", "GlobalWindows",
 ]
 
@@ -169,6 +170,17 @@ class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
     def of(size_ms: int, slide_ms: int,
            offset_ms: int = 0) -> "SlidingProcessingTimeWindows":
         return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+def reject_variable_pane_assigner(assigner, where: str) -> None:
+    """One guard for every fixed-panes-per-window fire program (device,
+    mesh): cumulate windows span a VARIABLE pane count and would silently
+    aggregate with sliding semantics."""
+    if isinstance(assigner, CumulateWindows):
+        raise ValueError(
+            f"cumulate windows cannot run on the {where} window operator "
+            "(variable panes per window); use the host WindowOperator "
+            "(.aggregate/.sum) or the SQL CUMULATE TVF")
 
 
 @dataclass(frozen=True)

@@ -271,6 +271,7 @@ env.config.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.2)
 env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
 env.config.set(RuntimeOptions.RESTART_ATTEMPTS, 5)
 env.config.set(RuntimeOptions.RESTART_DELAY, 0.1)
+env.config.set("state.backend.local-recovery", True)
 
 n = 3000
 def gen(idx):
@@ -294,6 +295,7 @@ with open(out_file, "wb") as f:
     pickle.dump({{"rows": sink.rows,
                   "restarts": host.coordinator.restarts
                   if host.coordinator else -1,
+                  "local_restores": host.local_restores,
                   "checkpoints": len(host.coordinator.completed)
                   if host.coordinator else -1}}, f)
 host.close()
@@ -349,6 +351,10 @@ def test_worker_death_redeploys_from_checkpoint():
         data = pickle.load(f)
     assert data["restarts"] >= 1
     assert data["checkpoints"] >= 1
+    # local recovery: the survivor restored its OWN subtasks from the
+    # locally-stashed ack copies (reference TaskLocalStateStore), while
+    # the dead worker's relocated subtasks came from checkpoint storage
+    assert data["local_restores"] >= 1
     # exactly-once state: the final sum of every key is exact — replayed
     # records did not double-count into the restored keyed state
     finals = {}
