@@ -326,8 +326,13 @@ class NFA:
         ignore_ok = True
         new_taking = taking
         if in_loop:
-            if cont == STRICT and e_matches is False and taking:
-                new_taking = False  # consecutive(): loop broken, may proceed
+            if cont == STRICT and taking:
+                # consecutive(): ANY ignored event — matching or not —
+                # breaks the run; the kept branch may still await the next
+                # stage but can never extend the loop again (ignoring a
+                # MATCHING event and taking a later one would be
+                # allow_combinations semantics)
+                new_taking = False
             if cont == RELAXED and took:
                 ignore_ok = False
             # waiting for next stage is allowed once min met as long as the

@@ -1,0 +1,135 @@
+"""Randomized NFA property tests: the streaming NFA vs a brute-force
+reference matcher over random event streams (SURVEY §5.2: property tests
+replace sanitizers — the NFA's branch logic is the riskiest host code, and
+every bug found in it this round was a semantics divergence a brute-force
+oracle would have caught)."""
+
+import numpy as np
+
+from flink_tpu.cep import Pattern
+from flink_tpu.cep.nfa import NFA, Event, SKIP_PAST_LAST_EVENT
+
+
+def _drive(nfa: NFA, symbols: list[int]) -> list[tuple]:
+    """Run symbols through the NFA; a match is summarized as a tuple of
+    (stage name, event index) pairs."""
+    partials, out = [], []
+    for seq, s in enumerate(symbols):
+        partials, matches = nfa.advance(
+            partials, Event(seq, seq * 1000, {"s": s, "i": seq}))
+        out.extend(matches)
+    partials, matches = nfa.prune(partials, 1 << 62)
+    out.extend(matches)
+    summarized = []
+    for m in out:
+        summarized.append(tuple(sorted(
+            (name, ev["i"]) for name, evs in m.events.items()
+            for ev in evs)))
+    return summarized
+
+
+def _brute_force_strict_runs(symbols, spec):
+    """Oracle for STRICT patterns (next() chains, consecutive loops):
+    enumerate every contiguous assignment matching ``spec`` =
+    [(name, want, min, max)] where each stage consumes min..max
+    consecutive events equal to ``want``."""
+    n = len(symbols)
+    results = set()
+
+    def rec(pos, stage_idx, acc):
+        if stage_idx == len(spec):
+            results.add(tuple(sorted(acc)))
+            return
+        name, want, lo, hi = spec[stage_idx]
+        for take in range(lo, hi + 1):
+            if pos + take > n:
+                break
+            if any(symbols[pos + j] != want for j in range(take)):
+                break
+            rec(pos + take, stage_idx + 1,
+                acc + [(name, pos + j) for j in range(take)])
+
+    for start in range(n):
+        rec(start, 0, [])
+    return results
+
+
+def test_strict_chain_matches_brute_force():
+    """A(=1) next B(=2) next C(=3): the NFA's match set over random
+    streams equals the contiguous-run oracle."""
+    rng = np.random.default_rng(7)
+    for trial in range(30):
+        symbols = rng.integers(1, 4, size=12).tolist()
+        pat = (Pattern.begin("A").where(lambda e: e["s"] == 1)
+               .next("B").where(lambda e: e["s"] == 2)
+               .next("C").where(lambda e: e["s"] == 3))
+        got = set(_drive(NFA(pat.compile()), symbols))
+        want = _brute_force_strict_runs(
+            symbols, [("A", 1, 1, 1), ("B", 2, 1, 1), ("C", 3, 1, 1)])
+        assert got == want, (trial, symbols, got, want)
+
+
+def test_consecutive_loop_matches_brute_force():
+    """A(=1){1..} consecutive, next B(=2): every maximal/partial split the
+    oracle enumerates must come out of the NFA and nothing else."""
+    rng = np.random.default_rng(11)
+    for trial in range(30):
+        symbols = rng.integers(1, 3, size=10).tolist()
+        pat = (Pattern.begin("A").where(lambda e: e["s"] == 1)
+               .one_or_more().consecutive()
+               .next("B").where(lambda e: e["s"] == 2))
+        got = set(_drive(NFA(pat.compile()), symbols))
+        want = _brute_force_strict_runs(
+            symbols, [("A", 1, 1, len(symbols)), ("B", 2, 1, 1)])
+        assert got == want, (trial, symbols, got, want)
+
+
+def test_greedy_per_start_is_longest_per_start():
+    """greedy_per_start + SKIP_PAST_LAST: the emitted matches are exactly
+    the oracle's longest-match-per-start, earliest starts first, with
+    overlaps pruned."""
+    rng = np.random.default_rng(23)
+    for trial in range(30):
+        symbols = rng.integers(1, 3, size=10).tolist()
+        pat = (Pattern.begin("A").where(lambda e: e["s"] == 1)
+               .one_or_more().consecutive()
+               .next("B").where(lambda e: e["s"] == 2))
+        nfa = NFA(pat.compile(), None, SKIP_PAST_LAST_EVENT,
+                  greedy_per_start=True)
+        got = _drive(nfa, symbols)
+
+        # oracle: all matches, keep the longest per start, then sweep by
+        # start pruning overlaps past the previous winner's last event
+        all_matches = _brute_force_strict_runs(
+            symbols, [("A", 1, 1, len(symbols)), ("B", 2, 1, 1)])
+        best: dict[int, tuple] = {}
+        for m in all_matches:
+            start = min(i for _, i in m)
+            cur = best.get(start)
+            if cur is None or max(i for _, i in m) > max(
+                    i for _, i in cur) or (
+                    max(i for _, i in m) == max(i for _, i in cur)
+                    and len(m) > len(cur)):
+                best[start] = m
+        expected, horizon = [], -1
+        for start in sorted(best):
+            if start <= horizon:
+                continue
+            expected.append(best[start])
+            horizon = max(i for _, i in best[start])
+        assert sorted(got) == sorted(expected), (trial, symbols, got,
+                                                 expected)
+
+
+def test_within_window_never_spans_longer():
+    """WITHIN: no emitted match spans more than the window."""
+    rng = np.random.default_rng(5)
+    for trial in range(20):
+        symbols = rng.integers(1, 3, size=12).tolist()
+        pat = (Pattern.begin("A").where(lambda e: e["s"] == 1)
+               .followed_by("B").where(lambda e: e["s"] == 2)
+               .within(3000))
+        got = _drive(NFA(pat.compile(), within_ms=3000), symbols)
+        for m in got:
+            idxs = [i for _, i in m]
+            assert (max(idxs) - min(idxs)) * 1000 <= 3000, (symbols, m)
