@@ -216,8 +216,16 @@ class WindowOperator(OneInputOperator):
             for w in overlapping:
                 ctx = self._trigger_ctx(key, w)
                 self._trigger.clear(w, ctx)
-                self._timers.delete_event_time_timer(
-                    key, self._cleanup_time(w), w)
+                # the absorbed window's CLEANUP timer lives in the time
+                # domain the assigner registered it in — deleting only the
+                # event-time one would leave a stale processing-time timer
+                # that later wipes the merged session's state
+                if self._assigner.is_event_time:
+                    self._timers.delete_event_time_timer(
+                        key, self._cleanup_time(w), w)
+                else:
+                    self._timers.delete_processing_time_timer(
+                        key, self._cleanup_time(w), w)
                 del mapping[w]
             mapping[merged] = target_state
             self._trigger.on_merge(merged, self._trigger_ctx(key, merged))

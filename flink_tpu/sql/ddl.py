@@ -39,8 +39,9 @@ from ..core.watermarks import WatermarkStrategy
 from .parser import SelectStmt, SqlError, _tokenize, parse
 
 __all__ = ["Catalog", "CatalogTable", "parse_statement", "CreateTableStmt",
-           "CreateViewStmt", "DropStmt", "ShowTablesStmt", "DescribeStmt",
-           "InsertStmt", "instantiate_source", "instantiate_sink",
+           "CreateViewStmt", "DropStmt", "ShowTablesStmt", "ShowViewsStmt",
+           "ShowCreateStmt", "DescribeStmt", "InsertStmt", "ExplainStmt",
+           "instantiate_source", "instantiate_sink",
            "sql_type_to_dtype", "dtype_to_sql_type"]
 
 _SQL_TYPES = {
@@ -106,6 +107,16 @@ class DropStmt:
 @dataclass
 class ShowTablesStmt:
     pass
+
+
+@dataclass
+class ShowViewsStmt:
+    pass
+
+
+@dataclass
+class ShowCreateStmt:
+    name: str
 
 
 @dataclass
@@ -291,8 +302,13 @@ def parse_statement(sql: str):
         return p.parse_drop()
     if head == "SHOW":
         p.expect_kw("SHOW")
-        p.expect_kw("TABLES")
-        return ShowTablesStmt()
+        what = p.expect_kw("TABLES", "VIEWS", "CREATE")
+        if what == "TABLES":
+            return ShowTablesStmt()
+        if what == "VIEWS":
+            return ShowViewsStmt()
+        p.expect_kw("TABLE")
+        return ShowCreateStmt(p.ident())
     if head in ("DESCRIBE", "DESC"):
         p.next()
         return DescribeStmt(p.ident())

@@ -21,8 +21,9 @@ from ..core.records import RecordBatch, Schema
 from . import rowkind as rk
 from .ddl import (
     Catalog, CatalogTable, CreateTableStmt, CreateViewStmt, DescribeStmt,
-    DropStmt, ExplainStmt, InsertStmt, ShowTablesStmt, dtype_to_sql_type,
-    instantiate_sink, instantiate_source, parse_statement, sql_type_to_dtype,
+    DropStmt, ExplainStmt, InsertStmt, ShowCreateStmt, ShowTablesStmt,
+    ShowViewsStmt, dtype_to_sql_type, instantiate_sink, instantiate_source,
+    parse_statement, sql_type_to_dtype,
 )
 from .parser import parse
 from .planner import PlanError, plan
@@ -202,6 +203,15 @@ class TableEnvironment:
                            | set(self._catalog))
             return TableResult(Schema([("table name", object)]),
                                [(n,) for n in names])
+        if isinstance(stmt, ShowViewsStmt):
+            views = sorted(
+                {n for n in self.catalog.names()
+                 if self.catalog.get(n).kind == "view"}
+                | set(self._catalog))
+            return TableResult(Schema([("view name", object)]),
+                               [(n,) for n in views])
+        if isinstance(stmt, ShowCreateStmt):
+            return self._show_create(stmt.name)
         if isinstance(stmt, DescribeStmt):
             entry = self.catalog.get(stmt.name)
             if entry is not None and entry.schema is not None:
@@ -328,6 +338,33 @@ class TableEnvironment:
             lines.append(sink_line)
         return TableResult(Schema([("plan", object)]),
                            [(ln,) for ln in lines])
+
+    def _show_create(self, name: str) -> "TableResult":
+        """Reconstruct the DDL from the catalog entry (reference SHOW
+        CREATE TABLE)."""
+        entry = self.catalog.get(name)
+        if entry is None or entry.kind != "spec":
+            raise PlanError(
+                f"SHOW CREATE TABLE: {name!r} is not a connector-backed "
+                "table in the catalog")
+        cols = [f"  {f.name} {dtype_to_sql_type(f.dtype)}"
+                for f in entry.schema.fields]
+        if entry.watermark_col:
+            # MILLISECOND keeps the delay exact (float formatting would
+            # silently round it on round-trip)
+            cols.append(f"  WATERMARK FOR {entry.watermark_col} AS "
+                        f"{entry.watermark_col} - INTERVAL "
+                        f"'{entry.watermark_delay_ms}' MILLISECOND")
+
+        def q(s: str) -> str:
+            return str(s).replace("'", "''")   # SQL string escaping
+
+        opts = ",\n".join(f"  '{q(k)}' = '{q(v)}'"
+                          for k, v in sorted(entry.options.items()))
+        ddl = (f"CREATE TABLE {entry.name} (\n" + ",\n".join(cols)
+               + f"\n) WITH (\n{opts}\n)")
+        return TableResult(Schema([("create statement", object)]),
+                           [(ddl,)])
 
     @staticmethod
     def _ok() -> "TableResult":

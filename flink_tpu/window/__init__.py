@@ -2,7 +2,8 @@
 
 from .assigners import (  # noqa: F401
     CumulateWindows, EventTimeSessionWindows, GlobalWindow, GlobalWindows,
-    SlidingEventTimeWindows, SlidingProcessingTimeWindows, TimeWindow,
+    ProcessingTimeSessionWindows, SlidingEventTimeWindows,
+    SlidingProcessingTimeWindows, TimeWindow,
     TumblingEventTimeWindows, TumblingProcessingTimeWindows, WindowAssigner,
 )
 from .triggers import (  # noqa: F401

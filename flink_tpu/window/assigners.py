@@ -27,7 +27,8 @@ __all__ = [
     "TumblingProcessingTimeWindows", "SlidingEventTimeWindows",
     "SlidingProcessingTimeWindows", "CumulateWindows",
     "reject_variable_pane_assigner",
-    "EventTimeSessionWindows", "GlobalWindows",
+    "EventTimeSessionWindows", "ProcessingTimeSessionWindows",
+    "GlobalWindows",
 ]
 
 
@@ -250,6 +251,17 @@ class EventTimeSessionWindows(WindowAssigner):
 
     def assign_windows(self, timestamp: int):
         return [TimeWindow(timestamp, timestamp + self.gap)]
+
+
+class ProcessingTimeSessionWindows(EventTimeSessionWindows):
+    """Session windows on processing time (reference
+    ProcessingTimeSessionWindows)."""
+
+    is_event_time = False
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "ProcessingTimeSessionWindows":
+        return ProcessingTimeSessionWindows(gap_ms)
 
 
 @dataclass(frozen=True)

@@ -487,3 +487,69 @@ def test_explain_insert_surfaces_execution_errors():
     with pytest.raises(Exception, match="columns"):
         t_env.execute_sql("EXPLAIN INSERT INTO nsink "
                           "SELECT auction, price FROM bids")
+
+
+def test_show_views_and_show_create_table():
+    t_env = TableEnvironment()
+    _mk_bids(t_env, rows=10)
+    t_env.execute_sql("CREATE VIEW cheap2 AS SELECT auction FROM bids "
+                      "WHERE price < 50")
+    views = [r[0] for r in t_env.execute_sql("SHOW VIEWS").collect()]
+    assert views == ["cheap2"]
+    tables = [r[0] for r in t_env.execute_sql("SHOW TABLES").collect()]
+    assert "bids" in tables
+
+    ddl = t_env.execute_sql("SHOW CREATE TABLE bids").collect()[0][0]
+    assert "CREATE TABLE bids" in ddl
+    assert "auction BIGINT" in ddl
+    assert "WATERMARK FOR ts" in ddl
+    assert "'connector' = 'datagen'" in ddl
+    # the reconstructed DDL round-trips into a working table
+    t2 = TableEnvironment()
+    t2.execute_sql(ddl)
+    got = t2.execute_sql("SELECT COUNT(*) FROM bids").collect_final()
+    assert got[0][0] == 10
+    with pytest.raises(Exception, match="SHOW CREATE TABLE"):
+        t_env.execute_sql("SHOW CREATE TABLE cheap2")
+
+
+def test_processing_time_session_windows():
+    """Processing-time sessions merge on wall-clock gaps; driven through
+    the deterministic harness (processing-time windows never fire at
+    bounded-job end, matching the reference)."""
+    import numpy as np
+
+    from flink_tpu.core.functions import AggregateFunction
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime import OneInputOperatorTestHarness
+    from flink_tpu.runtime.operators.window import WindowOperator
+    from flink_tpu.window import ProcessingTimeSessionWindows
+
+    class SumAgg(AggregateFunction):
+        def create_accumulator(self):
+            return 0
+
+        def add(self, value, acc):
+            return acc + value[1]
+
+        def get_result(self, acc):
+            return acc
+
+        def merge(self, a, b):
+            return a + b
+
+    def extract(batch):
+        return np.array([r[0] for r in batch.iter_rows()], dtype=object)
+
+    op = WindowOperator(ProcessingTimeSessionWindows.with_gap(200),
+                        extract, aggregate=SumAgg())
+    h = OneInputOperatorTestHarness(
+        op, schema=Schema([("k", np.int64), ("v", np.int64)]))
+    h.set_processing_time(0)
+    h.process_element((1, 1))
+    h.set_processing_time(100)          # within the gap: same session
+    h.process_element((1, 2))
+    h.set_processing_time(250)          # gap not yet elapsed since t=100
+    assert h.get_output() == []
+    h.set_processing_time(400)          # 100+200 passed: session fires
+    assert [r[-1] for r in h.get_output()] == [3]

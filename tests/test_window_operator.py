@@ -185,3 +185,30 @@ class TestSnapshotRestore:
         h2.process_element(("a", 10), 3)
         h2.process_watermark(9)
         assert sorted(h2.get_output()) == [("a", 11), ("b", 2)]
+
+
+class TestProcessingTimeSessionMerge:
+    def test_merge_deletes_stale_processing_time_cleanup(self):
+        """An absorbed proc-time session's CLEANUP timer must be deleted
+        in the PROCESSING-time domain: with a non-proc trigger (which
+        won't mask it via trigger.clear), a stale timer would fire at the
+        old window's cleanup time and wipe the merged session's state."""
+        from flink_tpu.window import (
+            EventTimeTrigger, ProcessingTimeSessionWindows,
+        )
+
+        def extract(batch):
+            return np.array([r[0] for r in batch.iter_rows()],
+                            dtype=object)
+
+        op = WindowOperator(ProcessingTimeSessionWindows.with_gap(200),
+                            extract, aggregate=SumAgg(),
+                            trigger=EventTimeTrigger())
+        h = OneInputOperatorTestHarness(op, schema=SCHEMA)
+        h.set_processing_time(0)
+        h.process_element(("a", 1))          # session [0, 200)
+        h.set_processing_time(100)
+        h.process_element(("a", 2))          # merges -> [0, 300)
+        h.set_processing_time(250)           # stale timer at 199 would fire
+        h.process_watermark(1_000)           # event-time trigger fires
+        assert h.get_output() == [("a", 3)]
