@@ -1,0 +1,171 @@
+"""Exact masked top-k by radix threshold selection.
+
+``lax.top_k`` over a full [capacity] accumulator is the single most
+expensive op in the device window-fire path (reference fire loop:
+WindowOperator.onEventTime:437 emitting ORDER BY ... LIMIT k results) —
+measured ~480 ms for k=1000 over 2M slots on one CPU host, because XLA
+lowers it to a variant of full sort. The fire only needs the k largest
+values and their slots, so this module finds the exact k-th threshold with
+a fixed number of histogram passes (radix select) and then compacts the
+survivors with one two-ended scatter:
+
+* 4 passes of 16-bit histograms walk the 64-bit key space top-down; after
+  pass p the threshold prefix is exact to 16*(p+1) bits, so 4 passes pin
+  the exact k-th largest value T. Each pass is one elementwise extract +
+  one scatter-add into 65536 bins — O(n) memory-bound work with no sort.
+* survivors split into STRICT (> T, provably fewer than k) and TIES (== T,
+  interchangeable by definition). Ties compact from the back of a [k]
+  buffer, strict from the front, strict written last so collisions resolve
+  in favor of strict — exactness without a second pass.
+
+Values map monotonically into uint64 (sign-flip for signed ints, the
+sign-magnitude trick for floats), so one implementation covers every
+accumulator dtype. Invalid slots are excluded from both the histograms and
+the final compaction.
+
+Contract matches lax.top_k + validity: ``(values[k], indices[k], ok[k])``
+sorted descending; ``ok[i]`` False marks padding when fewer than k valid
+slots exist.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_topk_radix", "masked_topk_sort", "masked_topk"]
+
+
+def _to_uint64(v: jax.Array) -> jax.Array:
+    """Monotone map of any ordered dtype into uint64 (order-preserving:
+    a < b  <=>  map(a) < map(b))."""
+    dt = v.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(
+            v, jnp.int32 if dt == jnp.float32 else jnp.int64)
+        bits = bits.astype(jnp.int64)
+        width = 32 if dt == jnp.float32 else 64
+        sign = jnp.int64(1) << (width - 1)
+        # positive floats: set sign bit; negative: flip all bits
+        u = jnp.where(bits >= 0, bits | sign,
+                      ~bits & ((sign << 1) - 1) if width == 32 else ~bits)
+        u = u.astype(jnp.uint64)
+        if width == 32:
+            u = u << 32  # widen keeping order
+        return u
+    # signed ints: flip the sign bit after widening
+    return (v.astype(jnp.int64).astype(jnp.uint64)
+            ^ jnp.uint64(1) << jnp.uint64(63))
+
+
+def masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
+                      value_bits: int = 64):
+    """Exact top-k among valid slots via 16-bit-per-pass radix select.
+
+    ``value_bits``: static upper bound on the bit width of the value
+    DOMAIN (after the monotone uint64 map the top bits are constant, so
+    passes over them resolve nothing). 64 is always safe; callers that
+    know their values are non-negative and bounded (window COUNTs, packed
+    price words) pass a tighter bound to drop whole histogram passes —
+    each pass is an O(n) scatter, the dominant cost at large n.
+    """
+    from .hash_table import ensure_x64
+
+    ensure_x64()  # uint64 radix walk needs x64 enabled
+    # tighter bound => non-negative values with the top bits constant
+    # after the sign-flip map (1 at bit 63, 0 down to value_bits): seed
+    # the prefix with those known bits and walk only the low fields.
+    # Floats always take the full walk: their monotone map packs the
+    # exponent into the HIGH bits, so a low-bits-only walk is wrong.
+    if (value_bits >= 64
+            or jnp.issubdtype(jnp.asarray(values).dtype, jnp.floating)):
+        passes = 4
+    else:
+        passes = max(1, -(-value_bits // 16))
+    return _masked_topk_radix(values, valid, k, passes)
+
+
+@partial(jax.jit, static_argnames=("k", "passes"))
+def _masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
+                       passes: int = 4):
+    n = values.shape[0]
+    k = min(k, n)
+    u = _to_uint64(values)
+    nvalid = jnp.sum(valid, dtype=jnp.int64)
+    kk = jnp.minimum(jnp.int64(k), nvalid)          # effective k
+    cand = valid
+    above = jnp.int64(0)                             # strictly above prefix
+    # with fewer than 4 passes the caller guarantees the skipped top bits
+    # are constant (non-negative values below 2^(16*passes)): after the
+    # sign flip that constant is exactly the sign bit
+    prefix = jnp.uint64(0) if passes >= 4 else jnp.uint64(1) << 63
+    bins = jnp.arange(65536, dtype=jnp.int64)
+    for shift in (48, 32, 16, 0)[4 - passes:]:
+        field = ((u >> shift) & jnp.uint64(0xFFFF)).astype(jnp.int32)
+        hist = jnp.zeros(65536, jnp.int64).at[field].add(
+            cand.astype(jnp.int64))
+        # count of candidates at-or-above each bin (descending cumulative)
+        revcum = jnp.cumsum(hist[::-1])[::-1]
+        # above + revcum[0] >= kk always holds (revcum[0] counts every
+        # candidate), so bstar is a real bin; when kk == 0 the condition
+        # is all-True and bstar saturates at 65535 (downstream masks are
+        # empty because valid is all-False in that case)
+        cond = (above + revcum) >= kk
+        bstar = jnp.max(jnp.where(cond, bins, -1))
+        above = above + jnp.where(bins > bstar, hist, 0).sum()
+        prefix = prefix | (bstar.astype(jnp.uint64) << shift)
+        cand = cand & (field.astype(jnp.int64) == bstar)
+    thr = prefix                                     # exact k-th largest
+    strict = valid & (u > thr)                       # provably < kk of them
+    tie = valid & (u == thr)
+    # two independent 1-D scans (a stacked [2, n] cumsum hits a slow XLA
+    # path: measured 72 ms vs 2x16 ms at n=2M on CPU)
+    cum_s = jnp.cumsum(strict.astype(jnp.int64))
+    cum_t = jnp.cumsum(tie.astype(jnp.int64))
+    # strict compacts from the front, ties from the back; strict written
+    # last so a collision keeps the strict element (ties all equal thr, so
+    # dropping any particular tie is exact)
+    tie_pos = jnp.clip(jnp.int64(k) - cum_t, 0, k - 1)
+    strict_pos = cum_s - 1
+    idx = jnp.arange(n, dtype=jnp.int64)
+    # compact only the INDEX (2 scatter passes); values gather back from
+    # the k winners — scatters over [n] are the cost that scales
+    buf_i = jnp.full(k, -1, jnp.int64)
+    buf_i = buf_i.at[jnp.where(tie, tie_pos, k)].set(idx, mode="drop")
+    buf_i = buf_i.at[jnp.where(strict, strict_pos, k)].set(idx, mode="drop")
+    filled = buf_i >= 0
+    sent = _sentinel(values.dtype)
+    buf_v = jnp.where(filled, values[jnp.maximum(buf_i, 0)], sent)
+    # order filled-first then by value descending (filled slots with the
+    # sentinel value are real data; unfilled sort behind via the flag)
+    order = jnp.lexsort((jnp.where(filled, _to_uint64(buf_v),
+                                   jnp.uint64(0)),
+                         filled))[::-1]
+    return buf_v[order], jnp.maximum(buf_i, 0)[order], filled[order]
+
+
+def _sentinel(dtype):
+    return (jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo(dtype).min)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def masked_topk_sort(values: jax.Array, valid: jax.Array, k: int):
+    """lax.top_k reference implementation (XLA sort-based)."""
+    sent = _sentinel(values.dtype)
+    masked = jnp.where(valid, values, sent)
+    kk = min(k, values.shape[0])
+    vals, idx = jax.lax.top_k(masked, kk)
+    return vals, idx, jnp.take(valid, idx)
+
+
+def masked_topk(values: jax.Array, valid: jax.Array, k: int,
+                value_bits: int = 64):
+    """Backend-tuned exact masked top-k: radix select everywhere by
+    default (XLA's sort-based top_k measured ~7x slower at [2M], k=1000 on
+    CPU; radix is O(n) scatter/reduce passes that also map well onto TPU
+    HBM bandwidth). Consumers needing the sort-based lowering can call
+    masked_topk_sort directly."""
+    return masked_topk_radix(values, valid, k, value_bits)
