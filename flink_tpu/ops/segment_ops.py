@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["scatter_fold", "pane_window_merge", "AGG_INITS", "AGG_FOLDS",
-           "AGG_MERGES", "make_accumulator", "segment_topk"]
+           "AGG_MERGES", "make_accumulator", "segment_topk", "pow2_ceil"]
 
 
 def _scatter_add(acc, idx, vals):
@@ -97,3 +97,12 @@ def segment_topk(values: jax.Array, valid: jax.Array, k: int
                else jnp.iinfo(values.dtype).min)
     masked = jnp.where(valid, values, neg_inf)
     return jax.lax.top_k(masked, k)
+
+
+def pow2_ceil(n: int) -> int:
+    """Next power of two >= n (n >= 1). Batches pad to power-of-two
+    lengths so one compiled executable serves every upstream batch size —
+    variable lengths (e.g. behind a WHERE filter) otherwise force an XLA
+    recompile per distinct shape (measured 15x slower than the fold
+    itself on the device GROUP BY path)."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1

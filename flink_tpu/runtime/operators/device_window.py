@@ -107,16 +107,23 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
 
     @partial(jax.jit, donate_argnums=donate)
     def step_fn(table, arrays, dropped, late, dirty, stage, touch, keys, ts,
-                cols, spilled, batch_no, first_open):
+                cols, spilled, batch_no, first_open, n_valid):
         panes = (ts.astype(jnp.int64) - offset) // pane
-        fresh = panes >= first_open
-        late = late + jnp.sum(~fresh).astype(jnp.int64)
+        # rows at/after n_valid are power-of-two padding (constant shapes
+        # keep ONE executable across variable upstream batch lengths, e.g.
+        # behind a WHERE filter); they fold nothing and count nothing
+        in_batch = jnp.arange(keys.shape[0]) < n_valid
+        fresh = (panes >= first_open) & in_batch
+        late = late + jnp.sum(~fresh & in_batch).astype(jnp.int64)
         keys = sanitize_keys_device(keys)
         if spill:
             from ...parallel.mesh import key_groups_device
 
             groups = key_groups_device(keys, spill_maxp)
-            touch = touch.at[groups].max(batch_no)
+            # padding rows must not touch the LRU clock (their zero key's
+            # group would read permanently hot and pin residency)
+            touch = touch.at[jnp.where(in_batch, groups, spill_maxp)].max(
+                batch_no, mode="drop")
             sp = spilled[groups]
             table, slots, ok = lookup_or_insert(table, keys, fresh & ~sp)
             to_host = fresh & (sp | ~ok)
@@ -406,18 +413,27 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                              self._backend.max_parallelism if spill else 0)
         arrays = {n: self._backend.get_array(n)
                   for n in self._fire_array_names()}
-        cols = {f: batch.device_column(f) for _k, _n, f in sig}
+        from ...ops.segment_ops import pow2_ceil
+
+        n = batch.n
+        P = pow2_ceil(n)
+
+        def _pad(a):
+            return (a if P == n
+                    else jnp.concatenate([a, jnp.zeros(P - n, a.dtype)]))
+
+        cols = {f: _pad(batch.device_column(f)) for _k, _n, f in sig}
         fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
         table, new_arrays, dropped, late, dirty, stage, touch = step(
             self._backend.table, arrays, self._backend.dropped_device,
             self._late_dev, self._backend.dirty_mask,
             self._stage if spill else None,
             self._backend.touch_device if spill else None,
-            batch.device_column(self._key_column),
-            batch.dtimestamps, cols,
+            _pad(batch.device_column(self._key_column)),
+            _pad(batch.dtimestamps), cols,
             self._backend.spilled_mask_device if spill else None,
             np.int64(self._backend.note_batch()) if spill else np.int64(0),
-            fo)
+            fo, np.int64(n))
         self._backend.table = table
         for n, a in new_arrays.items():
             self._backend.set_array(n, a)

@@ -158,7 +158,11 @@ class DeviceGroupAggOperator(OneInputOperator):
         self._plane_sig: list[tuple[str, str, Optional[str]]] = []
         for a in self._aggs:
             if a.kind == "count":
-                self._plane_sig.append((a.out_name, "sum", a.field))
+                # COUNT(col) == COUNT(*) on the device path: columns are
+                # numeric, never null (host op: sign * ~is_null(col) with
+                # is_null identically False for numeric dtypes) — fold the
+                # SIGN, not the value
+                self._plane_sig.append((a.out_name, "sum", None))
             elif a.kind in ("sum", "min", "max"):
                 self._plane_sig.append((a.out_name, a.kind, a.field))
             else:  # avg
@@ -206,8 +210,10 @@ class DeviceGroupAggOperator(OneInputOperator):
                     col_names.append(field)
                 fold_sig.append((name, kind, col_names.index(field)))
         # pad to the next power of two: constant shapes -> one executable
+        from ..ops.segment_ops import pow2_ceil
+
         n = batch.n
-        P = 1 << (n - 1).bit_length() if n > 1 else 1
+        P = pow2_ceil(n)
         pad = P - n
 
         def _padded(a: np.ndarray, fill) -> np.ndarray:

@@ -152,7 +152,6 @@ class TestCheckpoint:
         for rows, ts in batches[2:]:
             h.process_batch(RecordBatch.from_rows(SCHEMA, rows, ts))
             h2.process_batch(RecordBatch.from_rows(SCHEMA, rows, ts))
-        tail1 = _drain(h)[len(_drain(h2)):] if False else None
         # compare the post-restore emissions only
         out1 = _drain(h)
         out2 = _drain(h2)
@@ -160,6 +159,19 @@ class TestCheckpoint:
         # changelogs must agree row for row
         n2 = len(out2)
         assert _norm(out1[-n2:]) == _norm(out2)
+
+
+def test_count_column_is_count_not_sum():
+    """COUNT(v) must count rows, never sum values (review regression:
+    kind 'count' with a field was folding the column)."""
+    aggs = [SqlAggSpec("count", "v", "cv")]
+    rows = [(1, 10), (1, 10), (2, 7)]
+    host = _drive(GroupAggOperator(["k"], aggs), SCHEMA, [(rows, [0, 1, 2])])
+    dev = _drive(DeviceGroupAggOperator(["k"], aggs, capacity=16),
+                 SCHEMA, [(rows, [0, 1, 2])])
+    assert _norm(host) == _norm(dev)
+    by_key = {r[0]: r[1] for r in dev}
+    assert by_key[1] == 2.0 and by_key[2] == 1.0
 
 
 def test_combine_single_column_is_identity():
