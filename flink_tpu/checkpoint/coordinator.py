@@ -34,6 +34,43 @@ from .storage import (
 __all__ = ["CheckpointCoordinator", "build_restore_map"]
 
 
+def savepoint_self_contained(snapshots: dict, config: Configuration) -> dict:
+    """Savepoints must outlive the changelog backend's generation
+    truncation (reference: savepoints are canonical FULL snapshots).
+    Rewrite every changelog-dstl handle snapshot into the inline full
+    format — base + replay log embedded in the savepoint metadata — so
+    the savepoint's lifetime is owned by its storage, not by DSTL
+    cleanup. Shared by the local and distributed coordinators."""
+    import os
+    import pickle as _pickle
+
+    from ..state.dstl import read_any_base, read_any_segment
+
+    directory = config.get(CheckpointingOptions.DIRECTORY)
+    root = os.path.join(directory, "changelog") if directory else None
+
+    def rewrite(node):
+        if isinstance(node, dict):
+            if node.get("kind") == "changelog-dstl":
+                base = None
+                if node.get("base") is not None:
+                    base = _pickle.loads(read_any_base(
+                        node["driver"], node["base"], root))
+                base_seq = node.get("base_seq", 0)
+                records: list = []
+                for h in node.get("segments", []):
+                    records.extend(read_any_segment(h, root))
+                log = [rec for seq, rec in sorted(records)
+                       if seq > base_seq]
+                return {"kind": "changelog", "mat": base, "log": log}
+            return {k: rewrite(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [rewrite(v) for v in node]
+        return node
+
+    return rewrite(snapshots)
+
+
 @dataclass
 class _Pending:
     checkpoint_id: int
@@ -136,6 +173,8 @@ class CheckpointCoordinator:
             p.done.set()
 
     def _complete(self, p: _Pending) -> None:
+        if p.is_savepoint:
+            p.acks = savepoint_self_contained(p.acks, self.config)
         vertex_par = {vid: v.parallelism
                       for vid, v in self.job.job_graph.vertices.items()}
         vertex_uids = {vid: v.uid
@@ -173,7 +212,8 @@ class CheckpointCoordinator:
         # notify tasks (two-phase-commit sinks commit on this)
         for t in self.job.tasks.values():
             t.execute_in_mailbox(
-                lambda t=t: t.chain.notify_checkpoint_complete(p.checkpoint_id)
+                lambda t=t: t.chain.notify_checkpoint_complete(
+                    p.checkpoint_id, is_savepoint=p.is_savepoint)
                 if getattr(t, "chain", None) else None)
         p.completed = cp
         p.done.set()

@@ -279,10 +279,16 @@ class _Coordinator:
             self._pending_acks[cid].update(snapshots)
             self._pending_hosts[cid].discard(msg["host_id"])
             if not self._pending_hosts[cid]:
+                snaps = self._pending_acks.pop(cid)
+                sp = msg.get("savepoint", False)
+                if sp:
+                    from ..checkpoint.coordinator import \
+                        savepoint_self_contained
+                    snaps = savepoint_self_contained(snaps, self.config)
                 complete = CompletedCheckpoint(
                     checkpoint_id=cid, timestamp=time.time(),
-                    task_snapshots=self._pending_acks.pop(cid),
-                    is_savepoint=msg.get("savepoint", False),
+                    task_snapshots=snaps,
+                    is_savepoint=sp,
                     vertex_parallelism=dict(self._vertex_parallelism),
                     vertex_uids=dict(self._vertex_uids))
                 del self._pending_hosts[cid]
@@ -291,7 +297,8 @@ class _Coordinator:
             with self._lock:
                 self.completed.append(complete)
             self.broadcast({"type": "checkpoint_complete",
-                            "checkpoint_id": cid})
+                            "checkpoint_id": cid,
+                            "savepoint": complete.is_savepoint})
 
     # -- failover ----------------------------------------------------------
     def _maybe_restart(self, dead: list[int], reason: str) -> bool:
@@ -774,10 +781,12 @@ class DistributedHost:
                         for old in [c for c in self._local_snapshots
                                     if c < cid]:
                             del self._local_snapshots[old]
+                    sp = msg.get("savepoint", False)
                     for t in self.job.tasks.values():
                         t.execute_in_mailbox(
-                            lambda t=t, c=cid:
-                            t.chain.notify_checkpoint_complete(c)
+                            lambda t=t, c=cid, s=sp:
+                            t.chain.notify_checkpoint_complete(
+                                c, is_savepoint=s)
                             if getattr(t, "chain", None) else None)
                 elif msg["type"] == "restart":
                     with self._intent_lock:

@@ -152,11 +152,22 @@ class StreamOperator:
         'timers': dict|None} — serializable."""
         return {}
 
-    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
-        pass
+    def notify_checkpoint_complete(self, checkpoint_id: int,
+                                   is_savepoint: bool = False) -> None:
+        # operators owning a keyed backend (convention: self._backend)
+        # forward completions so backends with deferred artifact cleanup
+        # (changelog generations) can prune on SUBSUMPTION, not snapshots
+        backend = getattr(self, "_backend", None)
+        if backend is not None and hasattr(backend,
+                                           "notify_checkpoint_complete"):
+            backend.notify_checkpoint_complete(checkpoint_id,
+                                               is_savepoint=is_savepoint)
 
     def notify_checkpoint_aborted(self, checkpoint_id: int) -> None:
-        pass
+        backend = getattr(self, "_backend", None)
+        if backend is not None and hasattr(backend,
+                                           "notify_checkpoint_aborted"):
+            backend.notify_checkpoint_aborted(checkpoint_id)
 
 
 class OneInputOperator(StreamOperator):
@@ -273,9 +284,11 @@ class OperatorChain:
         return {_op_key(op): op.snapshot_state(checkpoint_id)
                 for op in self.operators}
 
-    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+    def notify_checkpoint_complete(self, checkpoint_id: int,
+                                   is_savepoint: bool = False) -> None:
         for op in self.operators:
-            op.notify_checkpoint_complete(checkpoint_id)
+            op.notify_checkpoint_complete(checkpoint_id,
+                                          is_savepoint=is_savepoint)
 
     def finish(self) -> None:
         for op in self.operators:

@@ -144,6 +144,16 @@ class KeyedStateBackend:
         this backend's range (rescaling restore)."""
         raise NotImplementedError
 
+    def notify_checkpoint_complete(self, checkpoint_id: int,
+                                    is_savepoint: bool = False) -> None:
+        """Coordinator confirmed the checkpoint completed (operators
+        forward this). Backends with deferred artifact cleanup (changelog
+        generations) prune here — never on snapshot attempts, which may
+        belong to checkpoints that later fail."""
+
+    def notify_checkpoint_aborted(self, checkpoint_id: int) -> None:
+        pass
+
     def dispose(self) -> None:
         pass
 

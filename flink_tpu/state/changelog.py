@@ -53,20 +53,21 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
         self._base_seq = 0                          # log seq covered by base
         self._mat_id = 0
         self._checkpoints_since_mat = 0
-        # retained checkpoints may reference superseded bases/segments:
-        # keep enough materialization GENERATIONS that the oldest retained
-        # checkpoint still restores (reference: artifact ownership +
-        # subsumption-driven cleanup; here derived from the retention
-        # config). Savepoints older than the kept window need the
-        # state-processor to rewrite them — documented limitation.
-        import math
+        # SUBSUMPTION-DRIVEN truncation (reference: DSTL/materialization
+        # artifact deletion rides checkpoint-subsumed notifications, never
+        # snapshot attempts — a run of FAILED checkpoints must not delete
+        # the artifacts of the last COMPLETED one). A superseded
+        # generation's base+segments retire into _retired and are deleted
+        # only when notify_checkpoint_complete proves every checkpoint the
+        # coordinator may still serve references a NEWER generation.
         retained = 1
         if config is not None:
             from ..core.config import CheckpointingOptions
             retained = config.get(CheckpointingOptions.RETAINED)
-        self._keep_generations = max(1, math.ceil(
-            retained / self._mat_interval))
-        self._old_generations: list[tuple[str, list]] = []
+        self._retained = max(1, int(retained))
+        self._retired: list[tuple[int, str, list]] = []  # (gen, base, segs)
+        self._ckpt_gen: dict[int, int] = {}     # snapshot cid -> generation
+        self._completed_gens: list[tuple[int, int]] = []  # (cid, gen)
 
     # -- logged mutations --------------------------------------------------
     def _put(self, desc: StateDescriptor, value: Any) -> None:
@@ -101,10 +102,11 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
     def materialize(self, checkpoint_id: int) -> None:
         """Full snapshot of the wrapped backend written ONCE to the
         changelog store. The previous generation's base + covered segments
-        move to deferred deletion: they are deleted only once enough newer
-        generations exist that no retained checkpoint references them."""
+        retire; deletion waits for a completion notification proving no
+        servable checkpoint still references that generation."""
         import uuid
 
+        prev_gen = self._mat_id
         self._mat_id += 1
         base = super().snapshot(checkpoint_id)
         prev_base = self._base_location
@@ -119,16 +121,11 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
         self._writer.drop_buffered()   # base covers them; don't upload dead
         covered = self._writer.detach(self._base_seq)
         if prev_base is not None:
-            self._old_generations.append((prev_base, covered))
+            self._retired.append((prev_gen, prev_base, covered))
         else:
             # no checkpoint ever referenced pre-first-materialization
             # segments (snapshot() materializes before returning handles)
             for h in covered:
-                self._store.delete_segment(h)
-        while len(self._old_generations) > self._keep_generations:
-            loc, segments = self._old_generations.pop(0)
-            self._store.delete_base(loc)
-            for h in segments:
                 self._store.delete_segment(h)
         self._checkpoints_since_mat = 0
 
@@ -138,6 +135,10 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
             self.materialize(checkpoint_id)
         self._checkpoints_since_mat += 1
         segments = self._writer.persist(self._base_seq)
+        self._ckpt_gen[checkpoint_id] = self._mat_id
+        if len(self._ckpt_gen) > 1024:      # aborted ids never notified
+            for cid in sorted(self._ckpt_gen)[:-1024]:
+                del self._ckpt_gen[cid]
         return {"kind": "changelog-dstl",
                 "driver": self._store.driver,
                 "base": self._base_location,
@@ -145,18 +146,62 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
                 "mat_id": self._mat_id,
                 "segments": [h.__dict__ for h in segments]}
 
+    # -- subsumption-driven truncation ---------------------------------
+    def notify_checkpoint_complete(self, checkpoint_id: int,
+                                    is_savepoint: bool = False) -> None:
+        gen = self._ckpt_gen.pop(checkpoint_id, None)
+        if gen is None:
+            return
+        if is_savepoint:
+            # savepoints are rewritten self-contained at completion (the
+            # coordinator inlines base+log) and never participate in the
+            # coordinator's regular-checkpoint retention — they must
+            # neither pin a generation nor evict a regular checkpoint's
+            # pin from the retained window
+            return
+        self._completed_gens.append((checkpoint_id, gen))
+        # the coordinator serves at most the last `retained` completed
+        # checkpoints; anything this backend snapshotted before those is
+        # subsumed. A retired generation is deletable once the OLDEST
+        # still-servable completed checkpoint references a newer one.
+        self._completed_gens = self._completed_gens[-self._retained:]
+        min_live_gen = min(g for _cid, g in self._completed_gens)
+        # in-flight snapshots (triggered, not yet completed/aborted) pin
+        # their generation too: a slower concurrent checkpoint may still
+        # complete after this one. Entries far below the completed id can
+        # no longer complete (outside any concurrency window) — drop them
+        # so abandoned triggers don't pin truncation forever.
+        for cid in [c for c in self._ckpt_gen if c < checkpoint_id - 64]:
+            del self._ckpt_gen[cid]
+        if self._ckpt_gen:
+            min_live_gen = min(min_live_gen, min(self._ckpt_gen.values()))
+        keep = []
+        for entry in self._retired:
+            if entry[0] < min_live_gen:
+                _gen, loc, segments = entry
+                self._store.delete_base(loc)
+                for h in segments:
+                    self._store.delete_segment(h)
+            else:
+                keep.append(entry)
+        self._retired = keep
+
+    def notify_checkpoint_aborted(self, checkpoint_id: int) -> None:
+        self._ckpt_gen.pop(checkpoint_id, None)
+
     def restore(self, snapshots: Iterable[dict]) -> None:
         bases, replogs, plain = [], [], []
         legacy_logs = []
         for snap in snapshots:
             kind = snap.get("kind")
             if kind == "changelog-dstl":
+                root = getattr(self._store, "dir", None)
                 if snap.get("base") is not None:
                     bases.append(pickle.loads(read_any_base(
-                        snap["driver"], snap["base"])))
+                        snap["driver"], snap["base"], root)))
                 records: list[tuple[int, Any]] = []
                 for h in snap.get("segments", []):
-                    records.extend(read_any_segment(h))
+                    records.extend(read_any_segment(h, root))
                 replogs.append((snap.get("base_seq", 0), records))
             elif kind == "changelog":      # old inline format
                 if snap.get("mat") is not None:

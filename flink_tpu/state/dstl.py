@@ -77,41 +77,50 @@ class FsChangelogStorage(_Store):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
 
+    def _resolve(self, location: str) -> str:
+        # handles store ROOT-RELATIVE names so a checkpoint directory can
+        # be moved/replicated and restored from a different mount path;
+        # absolute locations (pre-round-4 snapshots) still resolve as-is
+        return (location if os.path.isabs(location)
+                else os.path.join(self.dir, location))
+
     def write_segment(self, records: list) -> SegmentHandle:
         seg_id = uuid.uuid4().hex[:16]
-        path = os.path.join(self.dir, f"seg-{records[0][0]}-{seg_id}")
+        name = f"seg-{records[0][0]}-{seg_id}"
+        path = os.path.join(self.dir, name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(records, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
         return SegmentHandle(seg_id, records[0][0], records[-1][0],
-                             "fs", path)
+                             "fs", name)
 
     def read_segment(self, handle: SegmentHandle) -> list:
-        with open(handle.location, "rb") as f:
+        with open(self._resolve(handle.location), "rb") as f:
             return pickle.load(f)
 
     def delete_segment(self, handle: SegmentHandle) -> None:
         try:
-            os.unlink(handle.location)
+            os.unlink(self._resolve(handle.location))
         except OSError:
             pass
 
     def write_base(self, base_id: str, payload: bytes) -> str:
-        path = os.path.join(self.dir, f"base-{base_id}")
+        name = f"base-{base_id}"
+        path = os.path.join(self.dir, name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, path)
-        return path
+        return name
 
     def read_base(self, location: str) -> bytes:
-        with open(location, "rb") as f:
+        with open(self._resolve(location), "rb") as f:
             return f.read()
 
     def delete_base(self, location: str) -> None:
         try:
-            os.unlink(location)
+            os.unlink(self._resolve(location))
         except OSError:
             pass
 
@@ -154,21 +163,30 @@ class InMemoryChangelogStorage(_Store):
             _MEM.pop(location, None)
 
 
-def read_any_segment(handle_dict: dict) -> list:
+def _resolve_any(location: str, root: Optional[str]) -> str:
+    if os.path.isabs(location) or root is None:
+        return location
+    return os.path.join(root, location)
+
+
+def read_any_segment(handle_dict: dict, root: Optional[str] = None) -> list:
     """Reconstruct + read a segment from its serialized handle (restore may
     happen in a fresh process that only has the checkpoint payload). Pure
     read: no storage object is constructed, so restoring from a read-only
-    replica of the checkpoint directory works."""
+    replica of the checkpoint directory works. ``root`` resolves
+    root-relative handle locations against the restoring job's changelog
+    directory (absolute locations — old snapshots — pass through)."""
     h = SegmentHandle(**handle_dict)
     if h.driver == "fs":
-        with open(h.location, "rb") as f:
+        with open(_resolve_any(h.location, root), "rb") as f:
             return pickle.load(f)
     return InMemoryChangelogStorage().read_segment(h)
 
 
-def read_any_base(driver: str, location: str) -> bytes:
+def read_any_base(driver: str, location: str,
+                  root: Optional[str] = None) -> bytes:
     if driver == "fs":
-        with open(location, "rb") as f:
+        with open(_resolve_any(location, root), "rb") as f:
             return f.read()
     return InMemoryChangelogStorage().read_base(location)
 

@@ -46,9 +46,12 @@ class KeyedStateRecord(tuple):
         return self[2]
 
 
-def _iter_heap_states(keyed_snapshot: dict, state_name: str
+def _iter_heap_states(keyed_snapshot: dict, state_name: str,
+                      changelog_root: str = None
                       ) -> Iterator[KeyedStateRecord]:
-    """Iterate a heap/changelog-kind keyed snapshot's entries."""
+    """Iterate a heap/changelog-kind keyed snapshot's entries.
+    ``changelog_root`` resolves root-relative DSTL handle locations (the
+    checkpoint directory's /changelog subdir)."""
     snap = keyed_snapshot.get("backend", keyed_snapshot)
     if snap.get("kind") in ("changelog", "changelog-dstl"):
         # materialized base + replayed log = current view; reuse the
@@ -56,6 +59,10 @@ def _iter_heap_states(keyed_snapshot: dict, state_name: str
         from ..state.changelog import ChangelogKeyedStateBackend
         cb = ChangelogKeyedStateBackend(KeyGroupRange(0, (1 << 15) - 1),
                                         1 << 15)
+        if changelog_root is not None:
+            from ..state.dstl import FsChangelogStorage
+            cb._store = FsChangelogStorage(changelog_root)
+            cb._writer.store = cb._store
         cb.restore([snap])
         for (key, ns), value in cb.entries(state_name):
             yield KeyedStateRecord(key, ns, value)
@@ -69,14 +76,21 @@ def _iter_heap_states(keyed_snapshot: dict, state_name: str
 class SavepointReader:
     """Read an existing savepoint/checkpoint (reference SavepointReader)."""
 
-    def __init__(self, checkpoint: CompletedCheckpoint):
+    def __init__(self, checkpoint: CompletedCheckpoint,
+                 changelog_root: str = None):
         self.checkpoint = checkpoint
+        # DSTL handles are root-relative (relocatable checkpoints); the
+        # changelog store sits beside the chk-N/sp-N dirs
+        self.changelog_root = changelog_root
 
     @staticmethod
     def read(path: str) -> "SavepointReader":
+        import os as _os
+
         directory, _, leaf = path.rstrip("/").rpartition("/")
         storage = FsCheckpointStorage(directory or ".")
-        return SavepointReader(storage.load(path))
+        return SavepointReader(storage.load(path),
+                               _os.path.join(directory or ".", "changelog"))
 
     # -- inspection --------------------------------------------------------
     def vertices(self) -> list[str]:
@@ -115,11 +129,13 @@ class SavepointReader:
                     from ..state.dstl import read_any_base, read_any_segment
                     if inner.get("base") is not None:
                         base = _pk.loads(read_any_base(
-                            inner["driver"], inner["base"]))
+                            inner["driver"], inner["base"],
+                            self.changelog_root))
                         names.update(base.get("states", {}))
                     for h in inner.get("segments", []):
                         names.update(rec[1] for _seq, rec
-                                     in read_any_segment(h))
+                                     in read_any_segment(
+                                         h, self.changelog_root))
                     inner = {}
             names.update(inner.get("states", {}))
         return sorted(names)
@@ -140,7 +156,8 @@ class SavepointReader:
         out: list[KeyedStateRecord] = []
         for op in self._op_snapshots(vertex, op_key):
             if op.get("keyed"):
-                out.extend(_iter_heap_states(op["keyed"], state_name))
+                out.extend(_iter_heap_states(op["keyed"], state_name,
+                                             self.changelog_root))
         return out
 
     def operator_state(self, vertex: str, op_key: str,

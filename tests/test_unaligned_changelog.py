@@ -315,11 +315,12 @@ def test_dstl_fs_roundtrip_and_o_delta_bytes(tmp_path):
         put(b, i, i * 2, desc)
     s1 = b.snapshot(1)
     base_file = s1["base"]
-    base_size = os.path.getsize(base_file)
+    assert not os.path.isabs(base_file)      # relocatable handles
+    base_size = os.path.getsize(os.path.join(tmp_path, base_file))
     put(b, 7, 999, desc)
     s2 = b.snapshot(2)
     assert s2["base"] == base_file           # base not rewritten
-    seg_bytes = sum(os.path.getsize(h["location"])
+    seg_bytes = sum(os.path.getsize(os.path.join(tmp_path, h["location"]))
                     for h in s2["segments"])
     assert seg_bytes < base_size / 50        # delta << state
 
@@ -331,12 +332,13 @@ def test_dstl_fs_roundtrip_and_o_delta_bytes(tmp_path):
     assert b2.get_partitioned_state(desc).value() == 9998
 
 
-def test_dstl_batched_uploads_and_generational_truncation(tmp_path):
+def test_dstl_batched_uploads_and_subsumption_truncation(tmp_path):
     """Small flush threshold forces multiple segment uploads between
-    checkpoints. Materialization defers cleanup by one generation window:
-    a RETAINED checkpoint referencing the superseded base must still
-    restore; once enough newer generations exist, the old base + covered
-    segments are deleted from disk."""
+    checkpoints. Cleanup of superseded bases/segments is driven by
+    notify_checkpoint_complete (subsumption), NEVER by snapshot attempts:
+    a retained checkpoint referencing the superseded base must still
+    restore; once a newer checkpoint COMPLETES and subsumes it, the old
+    base + covered segments are deleted from disk."""
     import os
 
     from flink_tpu.state.dstl import FsChangelogStorage
@@ -348,26 +350,124 @@ def test_dstl_batched_uploads_and_generational_truncation(tmp_path):
     b._writer.store = b._store
     desc = ValueStateDescriptor("x")
     b.snapshot(1)                            # materialize #1 (empty base)
+    b.notify_checkpoint_complete(1)
     for i in range(100):
         put(b, i, i, desc)                   # >> 256 bytes: auto-flushes
     assert b._writer.segments_uploaded > 1   # batched, not one blob
     s2 = b.snapshot(2)
     assert len(s2["segments"]) == b._writer.segments_uploaded
+    b.notify_checkpoint_complete(2)
     s3 = b.snapshot(3)                       # interval hit: materialize #2
     assert s3["mat_id"] == 2 and s3["segments"] == []
-    # the RETAINED checkpoint s2 references generation-1 artifacts: they
-    # must survive the materialization and s2 must still restore
+    # checkpoint 3 has NOT completed yet: generation-1 artifacts must
+    # survive the materialization and s2 must still restore
     b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2._store = FsChangelogStorage(str(tmp_path))
+    b2._writer.store = b2._store
     b2.restore([s2])
     b2.set_current_key(42)
     assert b2.get_partitioned_state(desc).value() == 42
-    # two more checkpoints -> materialize #3 -> generation 1 ages out
-    b.snapshot(4)
-    b.snapshot(5)
+    # completion of 3 subsumes 2 (retained=1): generation 1 ages out
+    b.notify_checkpoint_complete(3)
     on_disk = [f for f in os.listdir(tmp_path) if f.startswith("seg-")]
     assert on_disk == []                     # gen-1 segments deleted
     bases = [f for f in os.listdir(tmp_path) if f.startswith("base-")]
-    assert len(bases) == 2                   # live + 1 kept generation
+    assert len(bases) == 1                   # only the live base remains
+
+
+def test_failed_checkpoints_never_delete_last_completed_artifacts(tmp_path):
+    """ADVICE r3 medium #1: a run of FAILED checkpoints (snapshots taken,
+    no completion notify) must not delete the artifacts of the last
+    COMPLETED checkpoint, no matter how many materializations happen."""
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=1)
+    b._store = FsChangelogStorage(str(tmp_path))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    for i in range(50):
+        put(b, i, i * 3, desc)
+    s1 = b.snapshot(1)                       # the only COMPLETED checkpoint
+    b.notify_checkpoint_complete(1)
+    # every subsequent checkpoint fails after snapshotting (acks lost);
+    # mat_interval=1 makes each one materialize a new generation
+    for cid in range(2, 10):
+        put(b, cid, cid, desc)
+        b.snapshot(cid)                      # no notify: failed
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2._store = FsChangelogStorage(str(tmp_path))
+    b2._writer.store = b2._store
+    b2.restore([s1])                         # must still be fully intact
+    b2.set_current_key(42)
+    assert b2.get_partitioned_state(desc).value() == 126
+
+
+def test_changelog_checkpoint_relocatable(tmp_path):
+    """ADVICE r3 low: handles store root-relative locations, so a moved /
+    replicated checkpoint directory restores from its new mount path."""
+    import shutil
+
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    src = tmp_path / "a" / "changelog"
+    dst = tmp_path / "b" / "changelog"
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=10,
+                                   flush_bytes=128)
+    b._store = FsChangelogStorage(str(src))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    b.snapshot(1)
+    for i in range(30):
+        put(b, i, i + 7, desc)
+    s2 = b.snapshot(2)
+    assert all(not h["location"].startswith("/")
+               for h in s2["segments"])
+    shutil.move(str(src), str(dst))          # relocate the directory
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2._store = FsChangelogStorage(str(dst))
+    b2._writer.store = b2._store
+    b2.restore([s2])
+    b2.set_current_key(3)
+    assert b2.get_partitioned_state(desc).value() == 10
+
+
+def test_savepoint_self_contained_survives_truncation(tmp_path):
+    """ADVICE r3 medium #2: savepoints rewrite changelog handles into the
+    inline full format at completion, so later generation truncation can
+    never invalidate them."""
+    from flink_tpu.checkpoint.coordinator import savepoint_self_contained
+    from flink_tpu.core.config import (
+        CheckpointingOptions, Configuration,
+    )
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=10)
+    store_dir = tmp_path / "ckpt" / "changelog"
+    b._store = FsChangelogStorage(str(store_dir))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    for i in range(20):
+        put(b, i, i * 5, desc)
+    sp_snap = b.snapshot(1)                  # handle-based savepoint ack
+    cfg = Configuration()
+    cfg.set(CheckpointingOptions.DIRECTORY, str(tmp_path / "ckpt"))
+
+    acks = {"t0": {"chain": {"op": {"keyed": {"backend": sp_snap}}}}}
+    rewritten = savepoint_self_contained(acks, cfg)
+    inline = rewritten["t0"]["chain"]["op"]["keyed"]["backend"]
+    assert inline["kind"] == "changelog"     # full, self-contained format
+    # wipe the entire changelog store (worst-case truncation): the
+    # savepoint must still restore
+    import shutil
+
+    shutil.rmtree(store_dir)
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2.restore([inline])
+    b2.set_current_key(4)
+    assert b2.get_partitioned_state(desc).value() == 20
 
 
 def test_dstl_legacy_inline_snapshot_restores():
@@ -387,3 +487,31 @@ def test_dstl_legacy_inline_snapshot_restores():
     b.set_current_key(1)
     desc = ValueStateDescriptor("counter")
     assert b.get_partitioned_state(desc).value() == 42
+
+
+def test_savepoint_completion_does_not_evict_checkpoint_pin(tmp_path):
+    """A completed SAVEPOINT must neither pin a generation nor evict the
+    retained regular checkpoint's pin (review regression: with retained=1
+    a savepoint completion trimmed the window and deleted the generation
+    the latest regular checkpoint still references)."""
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=1)
+    b._store = FsChangelogStorage(str(tmp_path))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    for i in range(30):
+        put(b, i, i * 2, desc)
+    s5 = b.snapshot(5)                       # regular, generation g
+    b.notify_checkpoint_complete(5)
+    put(b, 99, 1, desc)
+    b.snapshot(6)                            # savepoint: materializes g+1
+    b.notify_checkpoint_complete(6, is_savepoint=True)
+    # checkpoint 5's generation must still be on disk and restorable
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2._store = FsChangelogStorage(str(tmp_path))
+    b2._writer.store = b2._store
+    b2.restore([s5])
+    b2.set_current_key(7)
+    assert b2.get_partitioned_state(desc).value() == 14
