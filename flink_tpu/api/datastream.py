@@ -640,17 +640,28 @@ class WindowedStream:
                        emit_window_bounds: bool = True,
                        emit_topk: Optional[int] = None,
                        async_fire: bool = False,
+                       parallelism: int = 1,
                        name: str = "MeshWindowAgg") -> DataStream:
-        """Window aggregation as ONE mesh-sharded SPMD vertex: keyBy is the
+        """Window aggregation as a mesh-sharded SPMD vertex: keyBy is the
         on-device all_to_all exchange, state is sharded by key-group range
-        across the mesh (parallel/sharded_window.py). The vertex has host
-        parallelism 1 — its real parallelism is the device mesh.
-        ``emit_topk``/``async_fire`` match device_aggregate: two-phase
-        global top-k ranked on the first aggregate, fires emitting
-        asynchronously with watermarks held behind them."""
+        across the mesh (parallel/sharded_window.py). With
+        ``parallelism=1`` (default) the vertex is ONE subtask whose real
+        parallelism is the device mesh. ``parallelism=H`` composes DCN
+        with ICI for multi-host jobs: H subtasks each own a key-group
+        range (the keyed exchange crosses hosts over TCP) and re-shard it
+        across their host's local devices (all_to_all over ICI) —
+        SURVEY §5.8's two-level plan. ``emit_topk``/``async_fire`` match
+        device_aggregate: two-phase global top-k ranked on the first
+        aggregate, fires emitting asynchronously with watermarks held
+        behind them."""
         from ..runtime.operators.mesh_window import MeshWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("mesh aggregation needs a column key")
+        if emit_topk is not None and parallelism > 1:
+            raise ValueError(
+                "emit_topk with parallelism > 1 would rank each subtask's "
+                "key range separately, not globally; run the mesh top-k "
+                "at parallelism=1 or add a downstream global TopN")
         self._reject_variable_pane_assigner("mesh")
         assigner = self.assigner
         key_col = self.keyed.key_spec
@@ -663,7 +674,7 @@ class WindowedStream:
                 emit_window_bounds=emit_window_bounds,
                 emit_topk=emit_topk, async_fire=async_fire, name=name)
 
-        return self.keyed._one_input(name, factory, parallelism=1,
+        return self.keyed._one_input(name, factory, parallelism=parallelism,
                                      key_extractor=self.keyed.key_extractor)
 
 

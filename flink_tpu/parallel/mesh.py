@@ -40,10 +40,29 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS,
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
-def shard_ranges(max_parallelism: int, n_devices: int) -> list[KeyGroupRange]:
-    """Key-group range owned by each mesh position."""
-    return [key_group_range_for_operator(max_parallelism, n_devices, i)
-            for i in range(n_devices)]
+def shard_ranges(max_parallelism: int, n_devices: int,
+                 base: Optional[KeyGroupRange] = None
+                 ) -> list[KeyGroupRange]:
+    """Key-group range owned by each mesh position. With ``base``, the
+    devices split THAT subtask range instead of the full key space — the
+    two-level split of SURVEY §5.8: a multi-host job partitions key groups
+    across host subtasks over DCN (standard operator-index math), and each
+    host's local mesh re-partitions its subtask range across its devices
+    over ICI, with the same reference rounding rules applied in local
+    coordinates."""
+    if base is None:
+        return [key_group_range_for_operator(max_parallelism, n_devices, i)
+                for i in range(n_devices)]
+    length = base.end - base.start + 1
+    if length < n_devices:
+        raise ValueError(
+            f"subtask key-group range {base} has {length} groups < "
+            f"{n_devices} devices; raise pipeline.max-parallelism")
+    out = []
+    for i in range(n_devices):
+        r = key_group_range_for_operator(length, n_devices, i)
+        out.append(KeyGroupRange(base.start + r.start, base.start + r.end))
+    return out
 
 
 def _rotl32(x: jax.Array, r: int) -> jax.Array:
@@ -86,6 +105,12 @@ def key_groups_device(keys: jax.Array, max_parallelism: int) -> jax.Array:
 
 
 def device_index_for_key_groups(key_groups: jax.Array, n_devices: int,
-                                max_parallelism: int) -> jax.Array:
-    """Device twin of operator_index_for_key_group: kg * p // maxp."""
-    return (key_groups * jnp.int32(n_devices)) // jnp.int32(max_parallelism)
+                                max_parallelism: int,
+                                base_start: int = 0,
+                                base_len: Optional[int] = None) -> jax.Array:
+    """Device twin of operator_index_for_key_group: kg * p // maxp.
+    ``base_start``/``base_len`` scope the routing to a subtask's key-group
+    range (two-level split; see shard_ranges)."""
+    length = max_parallelism if base_len is None else base_len
+    return ((key_groups - jnp.int32(base_start))
+            * jnp.int32(n_devices)) // jnp.int32(length)

@@ -77,12 +77,18 @@ class ShardedWindowAgg:
 
     def __init__(self, mesh: Mesh, aggs: Sequence[AggDef],
                  capacity: int = 1 << 16, ring: int = 64,
-                 max_parallelism: int = 128):
+                 max_parallelism: int = 128, base_range=None):
+        """``base_range``: restrict this mesh to one SUBTASK's key-group
+        range (multi-host deployment: the vertex is parallelized across
+        hosts over DCN, each host's mesh owns its subtask range and
+        re-shards it across local devices over ICI). None = full space
+        (single-host mesh vertex)."""
         ensure_x64()
         if capacity & (capacity - 1):
             raise ValueError("capacity must be a power of two")
         self.mesh = mesh
         self.n_dev = mesh.devices.size
+        self.base_range = base_range
         if max_parallelism < self.n_dev:
             raise ValueError("max_parallelism must be >= mesh size")
         self.aggs = list(aggs)
@@ -94,7 +100,8 @@ class ShardedWindowAgg:
         self.capacity = capacity
         self.ring = ring
         self.max_parallelism = max_parallelism
-        self.shard_ranges = shard_ranges(max_parallelism, self.n_dev)
+        self.shard_ranges = shard_ranges(max_parallelism, self.n_dev,
+                                         base_range)
         self._sharding = NamedSharding(mesh, P(DATA_AXIS))
         self._step = self._build_step()
         self._fire = self._build_fire()
@@ -120,6 +127,8 @@ class ShardedWindowAgg:
     def _build_step(self):
         D, cap, ring = self.n_dev, self.capacity, self.ring
         MP = self.max_parallelism
+        base_start = self.shard_ranges[0].start
+        base_len = (self.shard_ranges[-1].end - base_start + 1)
         aggs = self.aggs
 
         def shard_body(table, accs, dropped, keys, cols, panes, valid):
@@ -129,7 +138,11 @@ class ShardedWindowAgg:
             panes, valid = panes[0], valid[0]
 
             kg = key_groups_device(keys, MP)
-            dest = device_index_for_key_groups(kg, D, MP)
+            dest = device_index_for_key_groups(kg, D, MP, base_start,
+                                               base_len)
+            # rows outside this subtask's range never fold (they belong to
+            # a peer host; a correct upstream exchange never sends them)
+            valid = valid & (dest >= 0) & (dest < D)
             payload = {"__key__": _sanitize(keys), "__pane__": panes, **cols}
             routed, rvalid = keyby_exchange(DATA_AXIS, D, dest, payload,
                                             valid)

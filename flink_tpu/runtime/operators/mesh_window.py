@@ -128,17 +128,38 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         super().setup(ctx, output)
-        n = self._n_devices or len(jax.devices())
+        # DCN x ICI composition (VERDICT r3 #3): with vertex parallelism
+        # P > 1 this subtask owns ctx.key_group_range (the standard keyed
+        # exchange delivers only its rows, over TCP when hosts differ) and
+        # its LOCAL mesh re-shards that range across this host's devices —
+        # DCN between hosts, ICI within the host, per SURVEY §5.8. With
+        # P == 1 (single-host mesh vertex) the base is the full key space
+        # and behavior is unchanged.
+        P = ctx.parallelism
+        local = jax.devices()
+        n = self._n_devices or (len(local) if P == 1
+                                else max(1, len(local) // P))
         self._n_devices = n
         # key groups must live in the job's max-parallelism space so mesh
         # checkpoints interoperate with host subtasks and other mesh sizes
         self._max_parallelism = ctx.max_parallelism
-        if self._max_parallelism < n:
+        self._base_range = ctx.key_group_range if P > 1 else None
+        base_len = (self._max_parallelism if self._base_range is None
+                    else self._base_range.end - self._base_range.start + 1)
+        if base_len < n:
             raise ValueError(
-                f"pipeline max-parallelism ({self._max_parallelism}) must "
-                f"be >= mesh size ({n}); raise "
-                "pipeline.max-parallelism")
-        self._mesh = make_mesh(n)
+                f"subtask key-group range ({base_len} groups) must be >= "
+                f"mesh size ({n}); raise pipeline.max-parallelism")
+        # single-process multi-host emulation (tests / one-host dev box):
+        # when the process sees every host's devices, subtasks take
+        # deterministic disjoint slices. On a real multi-host slice each
+        # process only sees its own chips and takes them all.
+        sub = ctx.subtask_index
+        if P > 1 and len(local) >= (sub + 1) * n:
+            devs = local[sub * n:(sub + 1) * n]
+        else:
+            devs = local[:n]
+        self._mesh = make_mesh(n, devices=devs)
 
     def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
         if not keyed_snapshots:
@@ -171,7 +192,8 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                ) -> None:
         self._agg = ShardedWindowAgg(
             self._mesh, defs, capacity=capacity or self._capacity,
-            ring=self._ring, max_parallelism=self._max_parallelism)
+            ring=self._ring, max_parallelism=self._max_parallelism,
+            base_range=self._base_range)
         self._state = self._agg.init_state()
 
     # -- data path ---------------------------------------------------------

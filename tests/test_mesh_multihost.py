@@ -1,0 +1,188 @@
+"""DCN x ICI composition (VERDICT r3 #3): a mesh window vertex with host
+parallelism > 1 — each subtask owns a key-group range (delivered over the
+keyed exchange, TCP when hosts differ) and re-shards it across its own
+local device mesh. Parity vs the host operator, checkpoint/restore across
+the composition, and a device-backed window job spanning two worker
+processes over the real transport."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.core import WatermarkStrategy
+from flink_tpu.core.config import PipelineOptions
+from flink_tpu.core.records import Schema
+from flink_tpu.runtime.operators.device_window import AggSpec
+from flink_tpu.window import SlidingEventTimeWindows, TumblingEventTimeWindows
+
+SCHEMA = Schema([("auction", np.int64), ("price", np.int64),
+                 ("ts", np.int64)])
+
+
+def _gen(idx):
+    return {"auction": idx % 61, "price": (idx * 7) % 100 + 1,
+            "ts": idx * 3}
+
+
+def _build(env, parallelism, n_devices, sink_rows, assigner=None):
+    from flink_tpu.connectors.core import CollectSink
+
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = CollectSink()
+    (env.datagen(_gen, SCHEMA, count=4000, timestamp_column="ts",
+                 watermark_strategy=ws)
+        .key_by("auction")
+        .window(assigner or SlidingEventTimeWindows.of(1000, 500))
+        .mesh_aggregate([AggSpec("sum", "price", out_name="total"),
+                         AggSpec("count", out_name="bids")],
+                        n_devices=n_devices, capacity=1 << 12,
+                        ring_size=32, emit_window_bounds=True,
+                        parallelism=parallelism)
+        .add_sink(sink, "collect"))
+    sink_rows.append(sink)
+    return env
+
+
+def _host_oracle():
+    idx = np.arange(4000)
+    keys = idx % 61
+    prices = (idx * 7) % 100 + 1
+    ts = idx * 3
+    out = {}
+    for s in range(-500, int(ts.max()) + 1, 500):
+        m = (ts >= s) & (ts < s + 1000)
+        if not m.any():
+            continue
+        for k in np.unique(keys[m]):
+            km = m & (keys == k)
+            out[(int(k), s + 1000)] = (int(prices[km].sum()), int(km.sum()))
+    return out
+
+
+def _collect(sink):
+    return {(int(r[0]), int(r[2])): (int(r[3]), int(r[4]))
+            for r in sink.rows}  # (auction, window_end) -> (total, bids)
+
+
+@pytest.mark.parametrize("parallelism,n_devices", [(2, 2), (2, 4), (4, 2)])
+def test_multihost_mesh_parity(parallelism, n_devices):
+    """P subtasks x D local devices each — results identical to a pure
+    host recomputation for every window."""
+    env = StreamExecutionEnvironment()
+    env.config.set(PipelineOptions.BATCH_SIZE, 256)
+    sinks = []
+    _build(env, parallelism, n_devices, sinks)
+    env.execute("mesh-multi", timeout=300.0)
+    got = _collect(sinks[0])
+    exp = _host_oracle()
+    # windows that fired must agree exactly; every key in a fired window
+    # must be present (subtasks fire per watermark, all see the stream end)
+    assert got == {k: v for k, v in exp.items() if k in got}
+    fired_ends = {we for _k, we in got}
+    for (k, we), v in exp.items():
+        if we in fired_ends:
+            assert got.get((k, we)) == v, (k, we)
+
+
+def test_multihost_checkpoint_rescale():
+    """Snapshot taken under (P=2, D=2) restores under (P=1, D=4) — the
+    key-group format crosses the DCN/ICI split transparently."""
+    from flink_tpu.runtime.harness import OneInputOperatorTestHarness
+    from flink_tpu.runtime.operators.mesh_window import MeshWindowAggOperator
+    from flink_tpu.core.elements import Watermark
+    from flink_tpu.core.records import RecordBatch
+
+    assigner = TumblingEventTimeWindows.of(1000)
+    rng = np.random.default_rng(3)
+    rows = [(int(k), int(p), int(t)) for k, p, t in
+            zip(rng.integers(0, 40, 600), rng.integers(1, 50, 600),
+                np.sort(rng.integers(0, 3000, 600)))]
+
+    def mk(par, sub, nd):
+        op = MeshWindowAggOperator(
+            assigner, "auction",
+            [AggSpec("sum", "price", out_name="total")],
+            n_devices=nd, capacity=1 << 10, ring_size=8)
+        h = OneInputOperatorTestHarness(op, SCHEMA, subtask_index=sub,
+                                        parallelism=par,
+                                        max_parallelism=128)
+        return op, h
+
+    # phase 1: two subtasks (P=2, D=2 each) ingest their key ranges
+    snaps = []
+    for sub in (0, 1):
+        op, h = mk(2, sub, 2)
+        own = [r for r in rows
+               if h.ctx.key_group_range.contains_key_of(r[0])] \
+            if hasattr(h.ctx.key_group_range, "contains_key_of") else None
+        if own is None:
+            from flink_tpu.core.keygroups import assign_to_key_group
+            own = [r for r in rows
+                   if assign_to_key_group(r[0], 128)
+                   in h.ctx.key_group_range]
+        h.process_batch(RecordBatch.from_rows(
+            SCHEMA, own, [r[2] for r in own]))
+        snap = op.snapshot_state(1)
+        snaps.append(snap["keyed"])
+    # phase 2: restore BOTH snapshots into one P=1, D=4 operator
+    op2, h2 = mk(1, 0, 4)
+    h2.open(keyed_snapshots=snaps)
+    h2.process_watermark(10_000)
+    op2.finish()
+    got = {}
+    for b in h2.output.batches:
+        for i in range(b.n):
+            got[(int(b.column("auction")[i]),
+                 int(b.column("window_end")[i]))] = \
+                int(b.column("total")[i])
+    exp = {}
+    for k, p, t in rows:
+        we = (t // 1000) * 1000 + 1000
+        exp[(k, we)] = exp.get((k, we), 0) + p
+    assert got == exp
+
+
+def test_device_window_job_spans_two_workers():
+    """The VERDICT r3 #3 'done' case: a device-backed (mesh) window job
+    whose vertex spans TWO DistributedHost workers — cross-host keyed
+    exchange over real TCP into per-host device meshes."""
+    from flink_tpu.cluster.distributed import DistributedHost
+
+    sinks = []
+    graphs = []
+    for h in range(2):
+        env = StreamExecutionEnvironment()
+        env.config.set(PipelineOptions.BATCH_SIZE, 256)
+        _build(env, 2, 2, sinks)
+        graphs.append(env.get_job_graph("mesh-dist"))
+    h0 = DistributedHost(graphs[0], graphs[0].config, 0, 2)
+    h1 = DistributedHost(graphs[1], graphs[1].config, 1, 2,
+                         coordinator_addr=f"127.0.0.1:"
+                         f"{h0.coordinator.port}")
+    peers = {0: h0.data_address, 1: h1.data_address}
+    threads = [threading.Thread(target=lambda hh=hh: hh.run(peers,
+                                                            timeout=120),
+                                daemon=True) for hh in (h1, h0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not any(t.is_alive() for t in threads)
+    h0.close()
+    h1.close()
+    # the sink (parallelism 1) lives on one host; results from BOTH mesh
+    # subtasks (placed on different hosts) must arrive there — full key
+    # coverage proves the cross-host half contributed over the wire
+    got = {}
+    for s in sinks:
+        got.update(_collect(s))
+    assert {k for k, _we in got} == set(range(61))
+    exp = _host_oracle()
+    fired_ends = {we for _k, we in got}
+    for (k, we), v in exp.items():
+        if we in fired_ends:
+            assert got.get((k, we)) == v, (k, we)
+    assert len(got) > 100
