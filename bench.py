@@ -508,6 +508,56 @@ def bench_wordcount(n_events: int = 500_000) -> float:
     return n_events / wall
 
 
+def bench_session(n_events: int = 1 << 21, n_keys: int = 100_000,
+                  device: bool = True) -> float:
+    """Session windows at 100K keys (VERDICT r3 #5 'done' criterion):
+    device session-lane operator vs the host merging WindowOperator.
+    ``device=False`` runs the host path on a smaller stream (it is
+    per-record Python); both report raw events/sec."""
+    from flink_tpu.core.functions import AggregateFunction
+    from flink_tpu.core.records import RecordBatch, Schema
+    from flink_tpu.runtime import OneInputOperatorTestHarness
+    from flink_tpu.window import EventTimeSessionWindows
+
+    schema = Schema([("k", np.int64), ("v", np.int64)])
+    rng = np.random.default_rng(0)
+    n = n_events if device else min(n_events, 1 << 17)
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    vals = rng.integers(1, 100, n).astype(np.int64)
+    ts = np.sort(rng.integers(0, 200_000, n)).astype(np.int64)
+    gap, B = 5000, 1 << 16
+    if device:
+        from flink_tpu.runtime.operators.device_session import (
+            DeviceSessionWindowOperator,
+        )
+        from flink_tpu.runtime.operators.device_window import AggSpec
+
+        op = DeviceSessionWindowOperator(
+            gap, "k", [AggSpec("sum", "v", out_name="total")],
+            capacity=1 << 18, lanes=4)
+    else:
+        from flink_tpu.runtime.operators import WindowOperator
+
+        class _Sum(AggregateFunction):
+            def create_accumulator(self): return 0
+            def add(self, value, acc): return acc + value[1]
+            def merge(self, a, b): return a + b
+            def get_result(self, acc): return acc
+
+        op = WindowOperator(
+            EventTimeSessionWindows.with_gap(gap),
+            lambda b: np.asarray(b.column("k")), aggregate=_Sum())
+    h = OneInputOperatorTestHarness(op, schema)
+    t0 = time.perf_counter()
+    for i in range(0, n, B):
+        h.process_batch(RecordBatch(
+            schema, {"k": keys[i:i + B], "v": vals[i:i + B]},
+            ts[i:i + B]))
+        h.process_watermark(int(ts[min(i + B, n) - 1]) - 1000)
+    h.process_watermark(1 << 40)
+    return n / (time.perf_counter() - t0)
+
+
 def bench_tpch_q1(n_rows: int = 1 << 22, backend: str = "tpu",
                   warmup: bool = True) -> float:
     """BASELINE config #5: TPC-H Q1 streaming GROUP BY through the SQL
@@ -690,6 +740,13 @@ def suite() -> None:
     join_eps = bench_framework_q7_join()
     _line("nexmark_q7_interval_join_events_per_sec", join_eps,
           "events/sec", join_eps / q7_host)
+
+    sess_host = bench_session(device=False)
+    sess_dev = bench_session()
+    _line("session_window_host_events_per_sec_100K_keys", sess_host,
+          "events/sec", 1.0)
+    _line("session_window_device_events_per_sec_100K_keys", sess_dev,
+          "events/sec/chip", sess_dev / sess_host)
 
     q1_host = bench_tpch_q1(1 << 21, backend="")
     q1_eps = bench_tpch_q1()

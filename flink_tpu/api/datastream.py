@@ -618,9 +618,38 @@ class WindowedStream:
         from ..runtime.operators.device_window import DeviceWindowAggOperator
         if not isinstance(self.keyed.key_spec, str):
             raise ValueError("device aggregation needs a column key")
-        self._reject_variable_pane_assigner("device")
         assigner = self.assigner
         key_col = self.keyed.key_spec
+
+        from ..window.assigners import EventTimeSessionWindows
+        if type(assigner) is EventTimeSessionWindows:
+            # merging session windows: device lanes operator (VERDICT r3
+            # #5) — host gap protocol, device-resident accumulators
+            if emit_topk is not None:
+                raise ValueError(
+                    "emit_topk is not supported for session windows")
+            if defer_overflow or async_fire or hbm_budget_slots:
+                raise ValueError(
+                    "defer_overflow/async_fire/hbm_budget_slots are not "
+                    "supported by the session operator yet; drop them or "
+                    "use the host WindowOperator path")
+            from ..runtime.operators.device_session import (
+                DeviceSessionWindowOperator,
+            )
+            gap = assigner.gap
+
+            def sess_factory():
+                return DeviceSessionWindowOperator(
+                    gap, key_col, aggs, capacity=capacity,
+                    lanes=max(4, min(ring_size, 16)),
+                    emit_window_bounds=emit_window_bounds, name=name)
+
+            par = 1 if self._all else None
+            return self.keyed._one_input(
+                name, sess_factory, parallelism=par,
+                key_extractor=self.keyed.key_extractor)
+
+        self._reject_variable_pane_assigner("device")
 
         def factory():
             return DeviceWindowAggOperator(

@@ -535,9 +535,8 @@ class _Planner:
             # parser: CUMULATE(..., INTERVAL step, INTERVAL size)
             assigner = CumulateWindows.of(tvf.size_ms, tvf.slide_ms)
         elif tvf.kind == "SESSION":
-            # merging windows: always the host WindowOperator path
-            # (sessions resist the fixed-pane device layout; reference
-            # likewise runs them in the generic WindowOperator)
+            # merging windows: device session-lane operator when the TPU
+            # backend is set (round 4); host WindowOperator otherwise
             from ..window import EventTimeSessionWindows
             assigner = EventTimeSessionWindows.with_gap(tvf.size_ms)
         else:
@@ -551,11 +550,12 @@ class _Planner:
         key_field = pre_schema.field(key_names[0])
         from ..core.config import StateOptions
         use_device = (self.env.config.get(StateOptions.BACKEND) == "tpu"
-                      and tvf.kind in ("TUMBLE", "HOP")
+                      and tvf.kind in ("TUMBLE", "HOP", "SESSION")
                       and key_field.is_numeric
                       and np.issubdtype(np.dtype(key_field.dtype),
                                         np.integer)
-                      and assigner.pane_size is not None)
+                      and (tvf.kind == "SESSION"
+                           or assigner.pane_size is not None))
         out_schema = Schema(
             [(key_names[0], key_field.dtype),
              ("window_start", np.int64), ("window_end", np.int64)]

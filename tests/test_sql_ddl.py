@@ -286,6 +286,50 @@ def test_session_window_tvf():
     assert by_key[2] == [(5000, 2)]
 
 
+def test_session_window_tvf_device():
+    """SESSION TVF with the TPU backend routes to the device session-lane
+    operator (round 4) and matches the host result."""
+    import numpy as np
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.records import Schema
+    from flink_tpu.sql import TableEnvironment as TE
+
+    schema = Schema([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+    rng = np.random.default_rng(4)
+    rows = [(int(k), 1, int(t)) for k, t in
+            zip(rng.integers(0, 8, 150),
+                np.sort(rng.integers(0, 120_000, 150)))]
+
+    def run(backend):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(1)
+        if backend:
+            env.set_state_backend(backend)
+        t = TE(env)
+        ds = env.from_collection(rows, schema,
+                                 timestamps=[r[2] for r in rows])
+        t.create_temporary_view("clicks", ds, schema)
+        got = t.execute_sql("""
+            SELECT k, window_start, window_end, COUNT(*) c, SUM(v) s FROM
+            SESSION(TABLE clicks, DESCRIPTOR(ts), INTERVAL '5' SECOND)
+            GROUP BY k, window_start, window_end""").collect_final()
+        from flink_tpu.runtime.operators.device_session import (
+            DeviceSessionWindowOperator,
+        )
+        routed = any(
+            isinstance(op, DeviceSessionWindowOperator)
+            for task in env.last_job.tasks.values()
+            for op in getattr(getattr(task, "chain", None), "operators",
+                              []))
+        return sorted(tuple(int(x) for x in r) for r in got), routed
+
+    host, host_routed = run("")
+    dev, dev_routed = run("tpu")
+    assert dev_routed and not host_routed
+    assert host == dev
+
+
 def test_cumulate_window_tvf():
     """CUMULATE TVF: expanding windows fire every step within the base
     window; counts accumulate (reference CumulateWindowSpec)."""
