@@ -45,7 +45,9 @@ class Serializer:
 @dataclass(frozen=True)
 class SerializerSnapshot:
     """Versioned serializer identity written next to state
-    (reference TypeSerializerSnapshot) — restore checks compatibility."""
+    (reference TypeSerializerSnapshot) — restore checks compatibility and
+    resolves a MIGRATION path on version mismatch (the
+    resolveSchemaCompatibility / compatibleAfterMigration contract)."""
 
     name: str
     version: int
@@ -69,6 +71,8 @@ class PickleSerializer(Serializer):
 class _Registry:
     def __init__(self):
         self._by_name: dict[str, Serializer] = {}
+        # (serializer name, from_version) -> value migration to from+1
+        self._migrations: dict[tuple[str, int], Callable[[Any], Any]] = {}
         self.register(PickleSerializer())
 
     def register(self, serializer: Serializer) -> None:
@@ -79,6 +83,26 @@ class _Registry:
 
     def default(self) -> Serializer:
         return self._by_name["pickle"]
+
+    # -- schema evolution (reference TypeSerializerSnapshot
+    # resolveSchemaCompatibility -> compatibleAfterMigration) ----------
+    def register_migration(self, name: str, from_version: int,
+                           fn: Callable[[Any], Any]) -> None:
+        """Register a VALUE migration for serializer ``name`` from
+        ``from_version`` to ``from_version + 1``; multi-version upgrades
+        chain (v1->v2->v3)."""
+        self._migrations[(name, int(from_version))] = fn
+
+    def has_migration_path(self, name: str, from_version: int,
+                           to_version: int) -> bool:
+        return all((name, v) in self._migrations
+                   for v in range(from_version, to_version))
+
+    def migrate_value(self, name: str, from_version: int,
+                      to_version: int, value: Any) -> Any:
+        for v in range(from_version, to_version):
+            value = self._migrations[(name, v)](value)
+        return value
 
 
 registry = _Registry()

@@ -196,41 +196,61 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
             kind = snap.get("kind")
             if kind == "changelog-dstl":
                 root = getattr(self._store, "dir", None)
+                base_sers = None
                 if snap.get("base") is not None:
-                    bases.append(pickle.loads(read_any_base(
-                        snap["driver"], snap["base"], root)))
+                    base = pickle.loads(read_any_base(
+                        snap["driver"], snap["base"], root))
+                    base_sers = base.get("serializers")
+                    bases.append(base)
                 records: list[tuple[int, Any]] = []
                 for h in snap.get("segments", []):
                     records.extend(read_any_segment(h, root))
-                replogs.append((snap.get("base_seq", 0), records))
+                replogs.append((snap.get("base_seq", 0), records,
+                                base_sers))
             elif kind == "changelog":      # old inline format
                 if snap.get("mat") is not None:
                     bases.append(snap["mat"])
-                legacy_logs.append(snap.get("log", []))
+                legacy_logs.append(
+                    (snap.get("log", []),
+                     (snap.get("mat") or {}).get("serializers")))
             else:
                 plain.append(snap)         # switching from another backend
         super().restore(bases + plain)
-        for base_seq, records in replogs:
+        for base_seq, records, sers in replogs:
             # segments may predate the base (flushed early): replay only
             # records the base does not already cover, in seq order
+            # (log values share the base's serializer era: same backend
+            # instance wrote both — migrate them identically)
+            mig_cache: dict = {}
             for seq, rec in sorted(records):
                 if seq > base_seq:
-                    self._apply(rec)
-        for log in legacy_logs:
+                    self._apply(rec, sers, mig_cache)
+        for log, sers in legacy_logs:
+            mig_cache = {}
             for rec in log:
-                self._apply(rec)
+                self._apply(rec, sers, mig_cache)
         # restored state is the new base: materialize on first snapshot
         self._base_location = None
         self._base_seq = self._writer.last_seq
         self._checkpoints_since_mat = 0
 
-    def _apply(self, rec: tuple) -> None:
+    def _apply(self, rec: tuple, snap_sers: dict = None,
+               mig_cache: dict = None) -> None:
         op, name, kg, payload, expiry = rec
         if int(kg) not in self.key_group_range:
             return
         table = self._table(name).setdefault(int(kg), {})
         if op == "put":
             key, ns, value = pickle.loads(payload)
+            # resolve the migration once per (state, snapshot), not per
+            # replayed record — the log can be large
+            if mig_cache is None:
+                mig_cache = {}
+            if name not in mig_cache:
+                mig_cache[name] = self._value_migration(name, snap_sers)
+            migrate = mig_cache[name]
+            if migrate is not None:
+                value = migrate(value)
             table[(key, ns)] = _Entry(value, expiry)
         else:
             key, ns = pickle.loads(payload)

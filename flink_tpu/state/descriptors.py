@@ -39,6 +39,9 @@ class StateDescriptor:
     ttl: Optional[StateTtlConfig] = None
     # queryable-state external name (reference setQueryable); None = private
     queryable_name: Optional[str] = None
+    # value serializer (None = registry default); its versioned snapshot
+    # is written with checkpoints and resolved on restore (migration)
+    serializer: Any = None
 
     def __post_init__(self):
         if self.kind not in ("value", "list", "reducing", "aggregating", "map"):
@@ -56,8 +59,10 @@ class StateDescriptor:
 
 
 def ValueStateDescriptor(name: str, default: Any = None,
-                         ttl: Optional[StateTtlConfig] = None) -> StateDescriptor:
-    return StateDescriptor(name, "value", default, ttl)
+                         ttl: Optional[StateTtlConfig] = None,
+                         serializer: Any = None) -> StateDescriptor:
+    return StateDescriptor(name, "value", default, ttl,
+                           serializer=serializer)
 
 
 def ListStateDescriptor(name: str,
@@ -81,6 +86,7 @@ class ReducingStateDescriptor(StateDescriptor):
         object.__setattr__(self, "default", None)
         object.__setattr__(self, "ttl", ttl)
         object.__setattr__(self, "queryable_name", None)
+        object.__setattr__(self, "serializer", None)
         object.__setattr__(self, "reduce_function", reduce_function)
 
 
@@ -95,4 +101,5 @@ class AggregatingStateDescriptor(StateDescriptor):
         object.__setattr__(self, "default", None)
         object.__setattr__(self, "ttl", ttl)
         object.__setattr__(self, "queryable_name", None)
+        object.__setattr__(self, "serializer", None)
         object.__setattr__(self, "aggregate_function", aggregate_function)

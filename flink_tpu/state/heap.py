@@ -45,6 +45,10 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         self._states: dict[str, dict[int, dict]] = {}
         self._descriptors: dict[str, StateDescriptor] = {}
         self._handles: dict[str, State] = {}
+        # per-state value serializer (None slots = registry default);
+        # snapshots record (name, version) per state and restore resolves
+        # version skew through registered migrations
+        self._serializers: dict[str, Any] = {}
 
     # -- internals ---------------------------------------------------------
     def _table(self, name: str) -> dict[int, dict]:
@@ -85,6 +89,8 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                 raise ValueError(
                     f"State {descriptor.name!r} already registered as {prev.kind}")
             self._descriptors[descriptor.name] = descriptor
+            if getattr(descriptor, "serializer", None) is not None:
+                self._serializers[descriptor.name] = descriptor.serializer
             handle = _HANDLE_TYPES[descriptor.kind](self, descriptor)
             self._handles[descriptor.name] = handle
             if descriptor.queryable_name and self.kv_registry is not None:
@@ -122,6 +128,13 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                 yield kn, e.value
 
     # -- checkpointing -----------------------------------------------------
+    def _serializer_for(self, name: str):
+        ser = self._serializers.get(name)
+        if ser is None:
+            from ..core.serializers import registry
+            ser = registry.default()
+        return ser
+
     def snapshot(self, checkpoint_id: int) -> dict:
         now = time.time()
         out: dict[str, dict[int, list]] = {}
@@ -133,13 +146,75 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                 if items:
                     per_kg[kg] = items
             out[name] = per_kg
-        return {"kind": "heap", "states": out}
+        # TypeSerializerSnapshot analog: record each state's serializer
+        # identity so restore can resolve schema evolution
+        sers = {}
+        for name in out:
+            ser = self._serializer_for(name)
+            sers[name] = [ser.name, ser.version]
+        return {"kind": "heap", "states": out, "serializers": sers}
+
+    def _value_migration(self, state_name: str, snap_sers: dict):
+        """Resolve the migration callable for one state of one snapshot:
+        None when versions match; raises with a precise message when no
+        path exists (reference resolveSchemaCompatibility ->
+        INCOMPATIBLE).
+
+        Restore runs BEFORE open() in the operator lifecycle, so state
+        descriptors (and their serializers) are usually not registered on
+        this backend yet; the CURRENT serializer for a non-default
+        snapshot therefore resolves through the process-global registry
+        by the RECORDED name — user serializers register there at import
+        (reference: the restored snapshot meets the new serializer
+        instance provided by user code)."""
+        rec = (snap_sers or {}).get(state_name)
+        if rec is None:
+            return None                       # pre-versioning snapshot
+        sname, sver = rec[0], int(rec[1])
+        cur = self._serializers.get(state_name)
+        if cur is None:
+            from ..core.serializers import registry
+            if sname == "pickle":
+                cur = registry.default()
+            else:
+                try:
+                    cur = registry.get(sname)
+                except KeyError:
+                    raise RuntimeError(
+                        f"state {state_name!r}: snapshot was written by "
+                        f"serializer {sname!r} v{sver}, which is not "
+                        "registered in this process "
+                        "(core.serializers.registry.register)") from None
+        if sname != cur.name:
+            raise RuntimeError(
+                f"state {state_name!r}: snapshot was written by serializer "
+                f"{sname!r} v{sver} but the current serializer is "
+                f"{cur.name!r} v{cur.version}; serializer replacement "
+                "needs an offline rewrite (state-processor API)")
+        if sver == cur.version:
+            return None
+        if sver > cur.version:
+            raise RuntimeError(
+                f"state {state_name!r}: snapshot serializer {sname!r} "
+                f"v{sver} is NEWER than the running v{cur.version}; "
+                "downgrade is not supported")
+        from ..core.serializers import registry
+        if not registry.has_migration_path(sname, sver, cur.version):
+            raise RuntimeError(
+                f"state {state_name!r}: serializer {sname!r} snapshot "
+                f"v{sver} is incompatible with current v{cur.version} and "
+                f"no migration chain v{sver}->v{cur.version} is "
+                "registered (registry.register_migration)")
+        return (lambda v, _n=sname, _f=sver, _t=cur.version:
+                registry.migrate_value(_n, _f, _t, v))
 
     def restore(self, snapshots: Iterable[dict]) -> None:
         self._states.clear()
         self._handles.clear()
         for snap in snapshots:
+            snap_sers = snap.get("serializers")
             for name, per_kg in snap.get("states", {}).items():
+                migrate = self._value_migration(name, snap_sers)
                 table = self._table(name)
                 for kg, items in per_kg.items():
                     kg = int(kg)
@@ -147,6 +222,8 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                         continue  # rescaling: not ours
                     m = table.setdefault(kg, {})
                     for kn, value, expiry in items:
+                        if migrate is not None:
+                            value = migrate(value)
                         m[tuple(kn) if isinstance(kn, list) else kn] = \
                             _Entry(value, expiry)
 
