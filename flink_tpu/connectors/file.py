@@ -100,14 +100,31 @@ class _FileReader(SourceReader):
     def read_batch(self, max_records: int) -> Optional[RecordBatch]:
         while self._file_idx < len(self._files):
             path = self._files[self._file_idx]
-            batch = (self._read_binary(path) if self._fmt.binary
-                     else self._read_text(path))
+            if getattr(self._fmt, "whole_file", False):
+                batch = self._read_whole_file(path)
+            elif self._fmt.binary:
+                batch = self._read_binary(path)
+            else:
+                batch = self._read_text(path)
             if batch is not None:
                 return batch
             self._file_idx += 1
             self._pos = 0
             self._pending = b""
         return None
+
+    def _read_whole_file(self, path: str) -> Optional[RecordBatch]:
+        """Whole-file formats (parquet): position = row-group index, so
+        checkpoint resume restarts at group granularity."""
+        fs, p = get_file_system(path)
+        with fs.open_read(p) as f:
+            batches, nxt, eof = self._fmt.read_row_groups(
+                f, self._pos, max_groups=1)
+        self._pos = nxt
+        if not batches:
+            return None
+        return batches[0] if len(batches) == 1 else \
+            RecordBatch.concat(batches)
 
     def _read_text(self, path: str) -> Optional[RecordBatch]:
         """Reads by byte offset (seek + readline) so resuming and batching
@@ -221,7 +238,11 @@ class _FileWriter(SinkWriter):
             return
         if self._fh is None:
             self._open()
-        if self._fmt.binary:
+            if getattr(self._fmt, "whole_file", False):
+                self._session = self._fmt.open_writer(self._fh)
+        if getattr(self, "_session", None) is not None:
+            self._session.write(batch)
+        elif self._fmt.binary:
             self._fh.write(self._fmt.encode_block(batch))
         else:
             self._fh.write(self._fmt.encode_batch(batch).encode("utf-8"))
@@ -233,6 +254,10 @@ class _FileWriter(SinkWriter):
         NEXT prepare_commit (size-based rolls stage under key None)."""
         if self._fh is None:
             return
+        session = getattr(self, "_session", None)
+        if session is not None:
+            session.close()        # parquet footer before the rename
+            self._session = None
         self._fh.close()
         self._pending.setdefault(-1 if checkpoint_id is None
                                  else checkpoint_id, []).append(

@@ -1,6 +1,15 @@
-"""Formats: CSV / JSON / native binary batch codecs (reference
-flink-formats). See formats/core.py."""
+"""Formats: CSV / JSON / native binary / columnar / avro / parquet codecs
+(reference flink-formats). See formats/core.py."""
 
 from .core import BinaryFormat, CsvFormat, Format, JsonFormat
 
-__all__ = ["Format", "CsvFormat", "JsonFormat", "BinaryFormat"]
+__all__ = ["Format", "CsvFormat", "JsonFormat", "BinaryFormat",
+           "ParquetFormat"]
+
+
+def __getattr__(name):
+    # lazy: pyarrow only loads when parquet is actually used
+    if name == "ParquetFormat":
+        from .parquet import ParquetFormat
+        return ParquetFormat
+    raise AttributeError(name)

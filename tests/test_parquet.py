@@ -1,0 +1,128 @@
+"""Parquet format (VERDICT r3 #8): columnar files <-> RecordBatch through
+the formats SPI and the file connectors (round trip, row-group resume,
+event-time preservation, object columns)."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from flink_tpu.core.records import RecordBatch, Schema
+from flink_tpu.formats.parquet import ParquetFormat
+
+SCHEMA = Schema([("k", np.int64), ("price", np.float64), ("tag", object)])
+
+
+def _batch(n, seed=0, t0=0):
+    rng = np.random.default_rng(seed)
+    return RecordBatch(
+        SCHEMA,
+        {"k": rng.integers(0, 50, n).astype(np.int64),
+         "price": rng.random(n),
+         "tag": np.array([f"t{i % 7}" for i in range(n)], dtype=object)},
+        np.arange(t0, t0 + n, dtype=np.int64))
+
+
+def _rows(b):
+    return [tuple(b.column(f.name)[i] for f in b.schema.fields)
+            + (int(b.timestamps[i]),) for i in range(b.n)]
+
+
+def test_round_trip_row_groups(tmp_path):
+    fmt = ParquetFormat(SCHEMA)
+    path = tmp_path / "part.parquet"
+    with open(path, "wb") as f:
+        w = fmt.open_writer(f)
+        w.write(_batch(100, seed=1, t0=0))
+        w.write(_batch(50, seed=2, t0=100))
+        w.close()
+    # two row groups; read them back one at a time
+    with open(path, "rb") as f:
+        b1, nxt, eof = fmt.read_row_groups(f, 0)
+    assert nxt == 1 and not eof and b1[0].n == 100
+    with open(path, "rb") as f:
+        b2, nxt, eof = fmt.read_row_groups(f, 1)
+    assert eof and b2[0].n == 50
+    assert _rows(b1[0]) == _rows(_batch(100, seed=1, t0=0))
+    assert _rows(b2[0]) == _rows(_batch(50, seed=2, t0=100))
+
+
+def test_timestamps_survive(tmp_path):
+    fmt = ParquetFormat(SCHEMA)
+    path = tmp_path / "p"
+    with open(path, "wb") as f:
+        w = fmt.open_writer(f)
+        w.write(_batch(10, t0=777))
+        w.close()
+    with open(path, "rb") as f:
+        (b,), _n, _e = fmt.read_row_groups(f, 0)
+    np.testing.assert_array_equal(b.timestamps, np.arange(777, 787))
+
+
+def test_no_timestamp_column(tmp_path):
+    fmt = ParquetFormat(SCHEMA, write_timestamps=False)
+    path = tmp_path / "p"
+    with open(path, "wb") as f:
+        w = fmt.open_writer(f)
+        w.write(_batch(5))
+        w.close()
+    with open(path, "rb") as f:
+        (b,), _n, _e = fmt.read_row_groups(f, 0)
+    assert "__ts__" not in b.schema
+    np.testing.assert_array_equal(b.timestamps, np.zeros(5))
+
+
+def test_file_source_sink_round_trip(tmp_path):
+    """FileSink writes parquet parts through the two-phase protocol; a
+    FileSource job reads them back — full pipeline round trip."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.connectors.file import FileSink, FileSource
+    from flink_tpu.core.config import PipelineOptions
+
+    out_dir = str(tmp_path / "out")
+    rows = [(int(i % 9), float(i) / 3, f"tag{i % 4}") for i in range(500)]
+
+    env = StreamExecutionEnvironment()
+    env.config.set(PipelineOptions.BATCH_SIZE, 64)
+    ds = env.from_collection(rows, SCHEMA,
+                             timestamps=list(range(len(rows))))
+    ds.sink_to(FileSink(out_dir, ParquetFormat(SCHEMA)), "parquet-sink")
+    env.execute("write-parquet", timeout=120.0)
+
+    import os
+    parts = [f for f in os.listdir(out_dir) if f.startswith("part")]
+    assert parts, os.listdir(out_dir)
+
+    env2 = StreamExecutionEnvironment()
+    env2.config.set(PipelineOptions.BATCH_SIZE, 64)
+    sink = CollectSink()
+    src = FileSource(out_dir, ParquetFormat(SCHEMA))
+    env2.from_source(src, name="parquet-source").add_sink(sink, "collect")
+    env2.execute("read-parquet", timeout=120.0)
+    got = sorted((int(k), round(float(p), 9), t) for k, p, t in sink.rows)
+    exp = sorted((k, round(p, 9), t) for k, p, t in rows)
+    assert got == exp
+
+
+def test_reader_resume_at_row_group(tmp_path):
+    from flink_tpu.connectors.file import _FileReader
+
+    fmt = ParquetFormat(SCHEMA)
+    path = str(tmp_path / "f.parquet")
+    with open(path, "wb") as f:
+        w = fmt.open_writer(f)
+        for g in range(4):
+            w.write(_batch(20, seed=g, t0=g * 20))
+        w.close()
+    r = _FileReader(fmt, [path], batch_lines=1000)
+    b0 = r.read_batch(1000)
+    b1 = r.read_batch(1000)
+    state = r.snapshot()
+    assert state["pos"] == 2
+    r2 = _FileReader(fmt, [path], batch_lines=1000)
+    r2.restore(state)
+    b2 = r2.read_batch(1000)
+    assert _rows(b2) == _rows(_batch(20, seed=2, t0=40))
+    rest = [r2.read_batch(1000)]
+    assert rest[0] is not None and r2.read_batch(1000) is None
