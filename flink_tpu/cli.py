@@ -99,6 +99,103 @@ def _cmd_savepoint(args) -> int:
     return 0
 
 
+def _read_statements(args):
+    """Yield complete ';'-terminated SQL statements from -e, -f, or an
+    interactive prompt (reference SqlClient's statement splitter)."""
+    if args.execute:
+        for part in args.execute.split(";"):
+            if part.strip():
+                yield part
+        return
+    if args.file:
+        with open(args.file) as f:
+            text = f.read()
+        for part in text.split(";"):
+            if part.strip():
+                yield part
+        return
+    try:
+        import readline  # noqa: F401 - line editing when available
+    except ImportError:
+        pass
+    print("Flink-TPU SQL client. Statements end with ';' — "
+          "'quit;' exits.", flush=True)
+    buf: list[str] = []
+    while True:
+        try:
+            line = input("sql> " if not buf else "   > ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        buf.append(line)
+        joined = "\n".join(buf)
+        while ";" in joined:
+            stmt, _, joined = joined.partition(";")
+            if stmt.strip().lower() in ("quit", "exit"):
+                return
+            if stmt.strip():
+                yield stmt
+        buf = [joined] if joined.strip() else []
+
+
+def _print_table(schema_names, rows, max_rows: int) -> None:
+    shown = rows[:max_rows]
+    cells = [[str(v) for v in r] for r in shown]
+    widths = [max([len(n)] + [len(c[i]) for c in cells])
+              for i, n in enumerate(schema_names)]
+
+    def line(vals):
+        return "| " + " | ".join(v.ljust(w)
+                                 for v, w in zip(vals, widths)) + " |"
+
+    sep = "+-" + "-+-".join("-" * w for w in widths) + "-+"
+    print(sep)
+    print(line(schema_names))
+    print(sep)
+    for c in cells:
+        print(line(c))
+    print(sep)
+    extra = len(rows) - len(shown)
+    tail = f" ({extra} more)" if extra > 0 else ""
+    print(f"{len(rows)} row(s){tail}", flush=True)
+
+
+def _cmd_sql(args) -> int:
+    """Interactive SQL client against a TableEnvironment (reference
+    flink-table/flink-sql-client SqlClient.java:67): DDL mutates the
+    session catalog; queries run and render their FINAL table (changelog
+    folded). ``--target`` submits query jobs to a session cluster."""
+    from .api.environment import StreamExecutionEnvironment
+    from .core.config import StateOptions
+    from .sql import TableEnvironment
+    from .sql import rowkind as rk
+
+    env = StreamExecutionEnvironment()
+    if args.parallelism:
+        env.set_parallelism(args.parallelism)
+    if args.state_backend:
+        env.config.set(StateOptions.BACKEND, args.state_backend)
+    if args.target:
+        env.set_remote_target(args.target)
+    t_env = TableEnvironment(env)
+    rc = 0
+    for stmt in _read_statements(args):
+        try:
+            res = t_env.execute_sql(stmt)
+        except Exception as e:  # the REPL survives bad statements
+            print(f"[ERROR] {e}", file=sys.stderr, flush=True)
+            if args.execute or args.file:
+                return 1       # script mode: fail fast, fail loudly
+            continue           # interactive: keep the session alive
+        names = [n for n in res.schema.names if n != rk.ROWKIND_COLUMN]
+        rows = res.collect_final()
+        if names == ["result"] and rows in ([("OK",)], [["OK"]]):
+            print("[INFO] OK", flush=True)
+        else:
+            _print_table(names, rows, args.max_rows)
+    return rc
+
+
 def _cmd_cluster(args) -> int:
     import time
 
@@ -158,6 +255,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     spi = sub.add_parser("savepoint-info", help="inspect a savepoint")
     spi.add_argument("path")
     spi.set_defaults(fn=_cmd_savepoint_info)
+
+    sql = sub.add_parser(
+        "sql", help="interactive SQL client (reference sql-client.sh)")
+    sql.add_argument("-e", "--execute", help="run statements and exit")
+    sql.add_argument("-f", "--file", help="run a .sql script and exit")
+    sql.add_argument("--target", help="session cluster host:port")
+    sql.add_argument("--state-backend", default="")
+    sql.add_argument("--parallelism", type=int, default=0)
+    sql.add_argument("--max-rows", type=int, default=100)
+    sql.set_defaults(fn=_cmd_sql)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=lambda a: (print("flink-tpu 0.1"), 0)[1])

@@ -1,0 +1,68 @@
+"""SQL client (VERDICT r3 #9, reference SqlClient.java:67): statement
+splitting, DDL + query execution with table rendering, script mode, and
+error handling."""
+
+import subprocess
+import sys
+
+import pytest
+
+
+def _run_sql(args, input_text=None):
+    return subprocess.run(
+        [sys.executable, "-m", "flink_tpu.cli", "sql"] + args,
+        capture_output=True, text=True, input=input_text, timeout=180,
+        cwd="/root/repo")
+
+
+def test_execute_ddl_and_query():
+    out = _run_sql(["-e", """
+        CREATE TABLE nums (k BIGINT, v BIGINT)
+        WITH ('connector'='datagen', 'number-of-rows'='40',
+              'fields.k.max'='3', 'fields.v.max'='9');
+        SELECT k, COUNT(*) c FROM nums GROUP BY k"""])
+    assert out.returncode == 0, out.stderr
+    assert "| k" in out.stdout and "| c" in out.stdout
+    assert "row(s)" in out.stdout
+
+
+def test_show_tables_and_ok():
+    out = _run_sql(["-e", """
+        CREATE TABLE t1 (a BIGINT) WITH ('connector'='datagen');
+        SHOW TABLES"""])
+    assert out.returncode == 0, out.stderr
+    assert "[INFO] OK" in out.stdout
+    assert "t1" in out.stdout
+
+
+def test_explain():
+    out = _run_sql(["-e", """
+        CREATE TABLE e1 (a BIGINT, b BIGINT)
+        WITH ('connector'='datagen');
+        EXPLAIN SELECT a, SUM(b) FROM e1 GROUP BY a"""])
+    assert out.returncode == 0, out.stderr
+    assert "GroupAggregate" in out.stdout
+
+
+def test_error_does_not_crash_interactive():
+    out = _run_sql([], input_text="SELECT FROM nowhere;\nquit;\n")
+    assert out.returncode == 0
+    assert "[ERROR]" in out.stderr
+
+
+def test_script_file(tmp_path):
+    script = tmp_path / "q.sql"
+    script.write_text(
+        "CREATE TABLE s (x BIGINT) WITH ('connector'='datagen', "
+        "'number-of-rows'='5');\n"
+        "SELECT COUNT(*) c FROM s;\n")
+    out = _run_sql(["-f", str(script)])
+    assert out.returncode == 0, out.stderr
+    assert "| 5" in out.stdout
+
+
+def test_script_error_exits_nonzero(tmp_path):
+    script = tmp_path / "bad.sql"
+    script.write_text("SELECT * FROM missing_table;\n")
+    out = _run_sql(["-f", str(script)])
+    assert out.returncode == 1
