@@ -188,8 +188,48 @@ def _fire_program(agg_sig: tuple, topk: Optional[int],
             sub = jnp.where(rows_valid[:, None], sub, ident)
             return AGG_MERGES[kind](sub, axis=0)
 
+        def merge_at(kind, arr, idx):
+            # winner-only merge: ONE [W, k] two-axis gather instead of a
+            # full [W, capacity] pane merge — with emit_topk only k slots
+            # ever emit, so secondary aggregates never pay the
+            # full-capacity read. (NOT arr[pane_rows][:, idx]: the
+            # chained form materializes the [W, cap] intermediate.)
+            sub = arr[pane_rows[:, None], idx[None, :]]
+            ident = AGG_INITS[kind](arr.dtype)
+            sub = jnp.where(rows_valid[:, None], sub, ident)
+            return AGG_MERGES[kind](sub, axis=0)
+
         count = merge("count", arrays["__count__"])
         emit = (table != jnp.int64(EMPTY_KEY)) & (count > 0)
+        occ = (table != jnp.int64(EMPTY_KEY)).sum()
+        if topk is not None:
+            # rank on the FIRST aggregate; everything else gathers at the
+            # k winners only
+            rk_kind, rk_name = agg_sig[0]
+            if rk_kind == "count":
+                ranked = count
+            elif rk_kind == "avg":
+                s = merge("sum", arrays[f"{rk_name}.sum"])
+                ranked = s / jnp.maximum(count, 1).astype(s.dtype)
+            else:
+                ranked = merge(rk_kind, arrays[rk_name])
+            _vals, idx, ok = _masked_topk(ranked, emit, topk,
+                                          value_bits=topk_value_bits)
+            keys = jnp.take(table, idx)
+            count_k = jnp.take(count, idx)
+            out = {}
+            for kind, out_name in agg_sig:
+                if out_name == rk_name:
+                    out[out_name] = jnp.take(ranked, idx)
+                elif kind == "count":
+                    out[out_name] = count_k
+                elif kind == "avg":
+                    s = merge_at("sum", arrays[f"{out_name}.sum"], idx)
+                    out[out_name] = s / jnp.maximum(count_k, 1).astype(
+                        s.dtype)
+                else:
+                    out[out_name] = merge_at(kind, arrays[out_name], idx)
+            return keys, ok, out, dropped, occ
         results = {}
         for kind, out_name in agg_sig:
             if kind == "count":
@@ -199,14 +239,6 @@ def _fire_program(agg_sig: tuple, topk: Optional[int],
                 results[out_name] = s / jnp.maximum(count, 1).astype(s.dtype)
             else:
                 results[out_name] = merge(kind, arrays[out_name])
-        occ = (table != jnp.int64(EMPTY_KEY)).sum()
-        if topk is not None:
-            ranked = results[agg_sig[0][1]]
-            _vals, idx, ok = _masked_topk(ranked, emit, topk,
-                                          value_bits=topk_value_bits)
-            keys = jnp.take(table, idx)
-            out = {n: jnp.take(r, idx) for n, r in results.items()}
-            return keys, ok, out, dropped, occ
         return table, emit, results, dropped, occ
 
     return fire_fn
