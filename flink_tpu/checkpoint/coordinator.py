@@ -77,6 +77,10 @@ class _Pending:
     started: float
     is_savepoint: bool
     acks: dict[str, dict] = field(default_factory=dict)
+    # task set captured AT TRIGGER TIME: completion must not shrink with
+    # job.tasks (a region restart temporarily removes tasks; a checkpoint
+    # completing without them would restore them empty later)
+    expected: frozenset = frozenset()
     declined: bool = False
     done = None  # threading.Event set on complete/abort
 
@@ -110,6 +114,7 @@ class CheckpointCoordinator:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_complete_time = 0.0
+        self._paused = False
         self.stats: list[dict] = []  # checkpoint stats history (REST/UI)
         job.checkpoint_listener = self._on_event
 
@@ -126,9 +131,12 @@ class CheckpointCoordinator:
                 "iteration jobs (feedback edges) cannot be checkpointed "
                 "or savepointed")
         with self._lock:
+            if self._paused:
+                raise RuntimeError("checkpointing paused (region restart)")
             cid = self._next_id
             self._next_id += 1
-            pending = _Pending(cid, time.time(), is_savepoint)
+            pending = _Pending(cid, time.time(), is_savepoint,
+                               expected=frozenset(self.job.tasks))
             self._pending[cid] = pending
         barrier = CheckpointBarrier(cid, is_savepoint=is_savepoint)
         for st in self.job.source_tasks.values():
@@ -159,7 +167,8 @@ class CheckpointCoordinator:
             if p is None or p.declined:
                 return
             p.acks[task_id] = snapshot
-            if set(p.acks) >= set(self.job.tasks):
+            expected = p.expected or frozenset(self.job.tasks)
+            if set(p.acks) >= set(expected):
                 del self._pending[checkpoint_id]
                 complete = p
         if complete is not None:
@@ -218,6 +227,21 @@ class CheckpointCoordinator:
         p.completed = cp
         p.done.set()
 
+    def pause(self) -> None:
+        """Hold new triggers and abort in-flight checkpoints — a region
+        restart removes tasks mid-flight; their checkpoints can never
+        complete and must not complete PARTIALLY either."""
+        with self._lock:
+            self._paused = True
+            for cid, p in list(self._pending.items()):
+                p.declined = True
+                p.done.set()
+                del self._pending[cid]
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
     def latest_checkpoint(self) -> Optional[CompletedCheckpoint]:
         with self._lock:
             return self._completed[-1] if self._completed else None
@@ -233,6 +257,8 @@ class CheckpointCoordinator:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
+            if self._paused:
+                continue
             now = time.time()
             with self._lock:
                 # abort timed-out pendings

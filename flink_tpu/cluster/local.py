@@ -95,6 +95,16 @@ class LocalJob(TaskReporter):
             t.cancel()
         self._done.set()
 
+    def wait_event(self, timeout: Optional[float] = None) -> bool:
+        """Wait for completion OR failure WITHOUT cancelling — the
+        supervisor uses this to attempt a region-scoped restart before
+        giving up on the whole job."""
+        return self._done.wait(timeout)
+
+    def current_failures(self) -> list:
+        with self._lock:
+            return list(self._failed)
+
     def wait(self, timeout: Optional[float] = None) -> None:
         if not self._done.wait(timeout):
             self.cancel()
@@ -148,7 +158,74 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
             "checkpointing enabled; disable execution.checkpointing."
             "interval for this job")
 
+    _deploy_vertices(job, job_graph, config, channels, restored_state,
+                     metrics_registry, set(job_graph.vertices))
+    return job
+
+
+def restart_region(job: "LocalJob", job_graph: JobGraph,
+                   config: Configuration, vids: set,
+                   restored_state: Optional[dict] = None) -> list[str]:
+    """Pipelined-region failover (reference
+    RestartPipelinedRegionFailoverStrategy.java:110): tear down and
+    rebuild ONLY the tasks of the given region's vertices inside a live
+    job — regions share no channels, so the rest of the job keeps
+    running untouched. Returns the restarted task ids."""
+    affected = [tid for tid in list(job.tasks)
+                if tid.rsplit("#", 1)[0] in vids]
+    old = []
+    for tid in affected:
+        t = job.tasks.pop(tid)
+        job.source_tasks.pop(tid, None)
+        t.cancel()
+        old.append(t)
+    for t in old:
+        # the old attempt must fully unwind BEFORE the new one deploys:
+        # its unwind path reports task_finished, which would otherwise
+        # mark the restarted task id as already finished
+        t.join(10)
+    # fresh channels for the region's (internal) edges
+    channels: dict[int, list[list[LocalChannel]]] = {}
+    for ei, e in enumerate(job_graph.edges):
+        if e.source_vertex not in vids:
+            continue
+        src = job_graph.vertices[e.source_vertex]
+        dst = job_graph.vertices[e.target_vertex]
+        channels[ei] = [
+            [LocalChannel(0) if e.feedback else LocalChannel()
+             for _ in range(dst.parallelism)]
+            for _ in range(src.parallelism)]
+    _deploy_vertices(job, job_graph, config, channels, restored_state,
+                     job.metrics_registry, vids)
+    with job._lock:
+        job._failed = [(tid, err) for tid, err in job._failed
+                       if tid.rsplit("#", 1)[0] not in vids]
+        # the cancelled attempt's tasks unwound through task_finished;
+        # their ids must count again for the NEW attempt
+        job._finished -= set(affected)
+        job._done.clear()
+        if job._failed:
+            # a DIFFERENT region failed during this restart window: its
+            # wake-up signal must survive the clear
+            job._done.set()
+    for tid in affected:
+        job.tasks[tid].start()
+    return affected
+
+
+def _deploy_vertices(job: "LocalJob", job_graph: JobGraph,
+                     config: Configuration, channels: dict,
+                     restored_state: Optional[dict],
+                     metrics_registry, vids: set) -> None:
+    from ..metrics.core import TaskMetrics
+
+    aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
+    unaligned = config.get(CheckpointingOptions.UNALIGNED)
+    alignment_timeout = config.get(CheckpointingOptions.ALIGNMENT_TIMEOUT)
+
     for vid, vertex in job_graph.vertices.items():
+        if vid not in vids:
+            continue
         out_edges = [(ei, e) for ei, e in enumerate(job_graph.edges)
                      if e.source_vertex == vid]
         in_edges = [(ei, e) for ei, e in enumerate(job_graph.edges)
@@ -253,7 +330,6 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
                 if snapshot:
                     task.restore_state(snapshot)
             job.tasks[task_id] = task
-    return job
 
 
 def _side_outputs_map(side_writers, metrics) -> Optional[dict[str, Output]]:

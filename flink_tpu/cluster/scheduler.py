@@ -93,7 +93,14 @@ class JobSupervisor:
                             f"job did not finish within {timeout}s")
                     remaining = (None if deadline is None
                                  else max(deadline - time.time(), 0.1))
-                    job.wait(remaining)
+                    if not job.wait_event(remaining):
+                        job.cancel()
+                        raise TimeoutError(
+                            f"Job did not finish within {timeout}s")
+                    if job.current_failures() and \
+                            self._try_region_restart(job):
+                        continue
+                    job.wait(0.1)  # raises for non-region-recoverable
                     if self.current_job is job and not self._rescaling:
                         break
                     if self.current_job is not job:
@@ -126,6 +133,44 @@ class JobSupervisor:
                 job.cancel()
                 time.sleep(self.restart_strategy.backoff_seconds())
                 restore = self._latest
+
+    def _try_region_restart(self, job: LocalJob) -> bool:
+        """Pipelined-region failover (reference
+        RestartPipelinedRegionFailoverStrategy.java:110): when the failed
+        tasks' regions do not span the whole graph, restart ONLY those
+        regions from the latest checkpoint — the other regions keep
+        running, their state untouched. Returns True when handled."""
+        from .local import restart_region
+        from .regions import affected_vertices, compute_regions
+
+        failed = job.current_failures()
+        if not failed:
+            return False
+        regions = compute_regions(self.job_graph)
+        if len(regions) <= 1:
+            return False
+        vids = affected_vertices(regions, [tid for tid, _e in failed])
+        if vids >= set(self.job_graph.vertices):
+            return False
+        self.restart_strategy.notify_failure()
+        if not self.restart_strategy.can_restart():
+            return False
+        self.failures.append((self.attempt, str(failed[0][1])))
+        latest = self.coordinator.latest_checkpoint()
+        restored = {}
+        if latest is not None:
+            self._latest = latest
+            restored = {tid: snap for tid, snap in build_restore_map(
+                latest, self.job_graph).items()
+                if tid.rsplit("#", 1)[0] in vids}
+        self.coordinator.pause()
+        try:
+            time.sleep(self.restart_strategy.backoff_seconds())
+            restart_region(job, self.job_graph, self.config, vids,
+                           restored)
+        finally:
+            self.coordinator.resume()
+        return True
 
     # -- elastic rescaling -------------------------------------------------
     def rescale(self, vertex_parallelism: dict[str, int],
