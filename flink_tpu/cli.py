@@ -99,20 +99,46 @@ def _cmd_savepoint(args) -> int:
     return 0
 
 
+def _split_statements(text: str) -> list[str]:
+    """Split on ';' OUTSIDE single-quoted SQL string literals ('' escapes
+    a quote inside a literal). Returns complete statements; a trailing
+    unterminated fragment is returned last un-split."""
+    out, buf, in_str = [], [], False
+    i = 0
+    while i < len(text):
+        ch = text[i]
+        if in_str:
+            if ch == "'":
+                if i + 1 < len(text) and text[i + 1] == "'":
+                    buf.append("''")
+                    i += 2
+                    continue
+                in_str = False
+            buf.append(ch)
+        elif ch == "'":
+            in_str = True
+            buf.append(ch)
+        elif ch == ";":
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    if "".join(buf).strip():
+        out.append("".join(buf))
+    return [s for s in out if s.strip()]
+
+
 def _read_statements(args):
     """Yield complete ';'-terminated SQL statements from -e, -f, or an
-    interactive prompt (reference SqlClient's statement splitter)."""
+    interactive prompt (reference SqlClient's statement splitter);
+    semicolons inside quoted literals do not split."""
     if args.execute:
-        for part in args.execute.split(";"):
-            if part.strip():
-                yield part
+        yield from _split_statements(args.execute)
         return
     if args.file:
         with open(args.file) as f:
-            text = f.read()
-        for part in text.split(";"):
-            if part.strip():
-                yield part
+            yield from _split_statements(f.read())
         return
     try:
         import readline  # noqa: F401 - line editing when available
@@ -129,13 +155,17 @@ def _read_statements(args):
             return
         buf.append(line)
         joined = "\n".join(buf)
-        while ";" in joined:
-            stmt, _, joined = joined.partition(";")
+        if ";" not in joined:
+            continue
+        parts = _split_statements(joined)
+        complete = (joined.rstrip().endswith(";")
+                    and (not parts or parts[-1].count("'") % 2 == 0))
+        tail = None if complete else (parts.pop() if parts else None)
+        for stmt in parts:
             if stmt.strip().lower() in ("quit", "exit"):
                 return
-            if stmt.strip():
-                yield stmt
-        buf = [joined] if joined.strip() else []
+            yield stmt
+        buf = [tail] if tail else []
 
 
 def _print_table(schema_names, rows, max_rows: int) -> None:
@@ -194,6 +224,48 @@ def _cmd_sql(args) -> int:
         else:
             _print_table(names, rows, args.max_rows)
     return rc
+
+
+def _cmd_sql_gateway(args) -> int:
+    """Serve the REST SQL gateway (reference SqlGatewayRestEndpoint)."""
+    import time
+
+    from .sql.gateway import SqlGateway
+
+    gw = SqlGateway(port=args.port, host=args.host,
+                    state_backend=args.state_backend)
+    gw.start()
+    print(f"sql gateway listening on {args.host}:{gw.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+        return 0
+
+
+def _cmd_deploy(args) -> int:
+    """Launch one SPMD script across N supervised worker processes
+    (reference start-cluster.sh + active resource manager drivers; see
+    cluster/deployment.py — a Kubernetes driver swaps the process
+    launcher for pod creation)."""
+    from .cluster.deployment import ProcessDeploymentDriver, SpmdDeployment
+
+    dep = SpmdDeployment(
+        args.script, n_hosts=args.hosts,
+        driver=ProcessDeploymentDriver(stdout_dir=args.log_dir or None),
+        max_worker_restarts=args.max_restarts)
+    dep.start()
+    print(f"deployed {args.hosts} workers; supervising", flush=True)
+    try:
+        codes = dep.wait(timeout=args.timeout)
+    except KeyboardInterrupt:
+        dep.stop()          # never orphan worker processes on Ctrl-C
+        print("interrupted; workers stopped", flush=True)
+        return 130
+    for hid in sorted(codes):
+        print(f"worker {hid}: exit {codes[hid]}")
+    return 0 if all(c == 0 for c in codes.values()) else 1
 
 
 def _cmd_cluster(args) -> int:
@@ -255,6 +327,22 @@ def main(argv: Optional[list[str]] = None) -> int:
     spi = sub.add_parser("savepoint-info", help="inspect a savepoint")
     spi.add_argument("path")
     spi.set_defaults(fn=_cmd_savepoint_info)
+
+    gwp = sub.add_parser("sql-gateway",
+                         help="serve the REST SQL gateway")
+    gwp.add_argument("--port", type=int, default=8083)
+    gwp.add_argument("--host", default="127.0.0.1")
+    gwp.add_argument("--state-backend", default="")
+    gwp.set_defaults(fn=_cmd_sql_gateway)
+
+    dep = sub.add_parser(
+        "deploy", help="run an SPMD script across N supervised workers")
+    dep.add_argument("script")
+    dep.add_argument("--hosts", type=int, default=2)
+    dep.add_argument("--log-dir", default="")
+    dep.add_argument("--max-restarts", type=int, default=2)
+    dep.add_argument("--timeout", type=float, default=3600.0)
+    dep.set_defaults(fn=_cmd_deploy)
 
     sql = sub.add_parser(
         "sql", help="interactive SQL client (reference sql-client.sh)")

@@ -1,0 +1,164 @@
+"""SQL gateway: REST sessions + statement execution.
+
+Analog of the reference's SQL gateway
+(flink-table/flink-sql-gateway .../rest/SqlGatewayRestEndpoint.java:63 +
+SqlGatewayServiceImpl): long-lived SESSIONS each own a TableEnvironment
+(catalog state persists across statements), and clients drive them over
+plain HTTP/JSON:
+
+    POST   /v1/sessions                       -> {"session_id"}
+    POST   /v1/sessions/{id}/statements       {"statement": "..."}
+                                              -> {"columns", "rows"}
+    GET    /v1/sessions/{id}                  -> session info
+    DELETE /v1/sessions/{id}                  -> close
+    GET    /v1/info                           -> gateway version info
+
+Queries execute synchronously and return their FINAL table (changelog
+folded) — the micro-batch model makes bounded SQL complete quickly, so
+the reference's operation-handle polling collapses to one round trip.
+Statement errors return 400 with the message; the session survives.
+
+The transport carries only JSON (no pickle): unlike the intra-cluster
+control sockets, the gateway is safe to expose beyond the trust boundary
+(rows are rendered to JSON-safe scalars).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from typing import Any, Optional
+
+from ..utils.httpd import ThreadedHTTPServer
+
+__all__ = ["SqlGateway"]
+
+
+def _json_safe(v: Any):
+    import numpy as np
+
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+class _Session:
+    def __init__(self, state_backend: str = ""):
+        from ..api.environment import StreamExecutionEnvironment
+        from ..core.config import StateOptions
+        from . import TableEnvironment
+
+        self.env = StreamExecutionEnvironment()
+        if state_backend:
+            self.env.config.set(StateOptions.BACKEND, state_backend)
+        self.t_env = TableEnvironment(self.env)
+        self.lock = threading.Lock()  # one statement at a time per session
+
+
+class SqlGateway:
+    """Embeddable gateway server (also `flink-tpu sql-gateway`)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 state_backend: str = ""):
+        self._sessions: dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._backend = state_backend
+        gateway = self
+
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if parts[:2] == ["v1", "info"]:
+                    return self._send(200, {"productName": "flink-tpu",
+                                            "version": "0.1"})
+                if len(parts) == 3 and parts[:2] == ["v1", "sessions"]:
+                    sid = parts[2]
+                    if sid in gateway._sessions:
+                        return self._send(200, {"session_id": sid})
+                    return self._send(404, {"error": "unknown session"})
+                return self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                if parts == ["v1", "sessions"]:
+                    sid = gateway.open_session()
+                    return self._send(200, {"session_id": sid})
+                if (len(parts) == 4 and parts[:2] == ["v1", "sessions"]
+                        and parts[3] == "statements"):
+                    sid = parts[2]
+                    stmt = self._body().get("statement", "")
+                    try:
+                        out = gateway.execute(sid, stmt)
+                    except KeyError:
+                        return self._send(404,
+                                          {"error": "unknown session"})
+                    except Exception as e:
+                        return self._send(400, {"error": str(e)})
+                    return self._send(200, out)
+                return self._send(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "sessions"]:
+                    gateway.close_session(parts[2])
+                    return self._send(200, {"status": "closed"})
+                return self._send(404, {"error": "not found"})
+
+        self._server = ThreadedHTTPServer(Handler, port=port, host=host,
+                                          name="sql-gateway")
+        self.port: int = self._server.port
+
+    # -- service -----------------------------------------------------------
+    def open_session(self) -> str:
+        sid = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._sessions[sid] = _Session(self._backend)
+        return sid
+
+    def close_session(self, sid: str) -> None:
+        with self._lock:
+            self._sessions.pop(sid, None)
+
+    def execute(self, sid: str, statement: str) -> dict:
+        from . import rowkind as rk
+
+        sess = self._sessions[sid]
+        with sess.lock:
+            res = sess.t_env.execute_sql(statement)
+        names = [n for n in res.schema.names if n != rk.ROWKIND_COLUMN]
+        rows = [[_json_safe(v) for v in r] for r in res.collect_final()]
+        return {"columns": names, "rows": rows}
+
+    def start(self) -> int:
+        return self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+        with self._lock:
+            self._sessions.clear()

@@ -61,6 +61,21 @@ def test_script_file(tmp_path):
     assert "| 5" in out.stdout
 
 
+def test_semicolon_inside_string_literal():
+    """The statement splitter must not split inside quoted SQL literals
+    (review regression)."""
+    from flink_tpu.cli import _split_statements
+
+    parts = _split_statements(
+        "CREATE TABLE t (a BIGINT) WITH ('x'='a;b'); SHOW TABLES")
+    assert len(parts) == 2
+    assert "'a;b'" in parts[0]
+    assert parts[1].strip() == "SHOW TABLES"
+    # escaped quote inside a literal
+    parts = _split_statements("SELECT 'it''s; fine'; SHOW TABLES")
+    assert len(parts) == 2 and "it''s; fine" in parts[0]
+
+
 def test_script_error_exits_nonzero(tmp_path):
     script = tmp_path / "bad.sql"
     script.write_text("SELECT * FROM missing_table;\n")

@@ -1,0 +1,95 @@
+"""SQL gateway REST endpoint (reference SqlGatewayRestEndpoint): session
+lifecycle, statement execution over HTTP/JSON, catalog persistence within
+a session, error handling."""
+
+import json
+import urllib.request
+
+import pytest
+
+from flink_tpu.sql.gateway import SqlGateway
+
+
+@pytest.fixture()
+def gw():
+    g = SqlGateway()
+    g.start()
+    yield g
+    g.stop()
+
+
+def _req(gw, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{gw.port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_info(gw):
+    code, out = _req(gw, "GET", "/v1/info")
+    assert code == 200 and out["productName"] == "flink-tpu"
+
+
+def test_session_ddl_and_query(gw):
+    code, out = _req(gw, "POST", "/v1/sessions")
+    assert code == 200
+    sid = out["session_id"]
+    code, out = _req(gw, "POST", f"/v1/sessions/{sid}/statements",
+                     {"statement": "CREATE TABLE g (k BIGINT, v BIGINT) "
+                                   "WITH ('connector'='datagen', "
+                                   "'number-of-rows'='30', "
+                                   "'fields.k.max'='2')"})
+    assert code == 200, out
+    # catalog persists across statements within the session
+    code, out = _req(gw, "POST", f"/v1/sessions/{sid}/statements",
+                     {"statement": "SELECT k, COUNT(*) c FROM g "
+                                   "GROUP BY k"})
+    assert code == 200, out
+    assert out["columns"] == ["k", "c"]
+    assert sum(r[1] for r in out["rows"]) == 30
+    # rows are JSON scalars, not numpy reprs
+    assert all(isinstance(r[1], (int, float)) for r in out["rows"])
+
+
+def test_sessions_are_isolated(gw):
+    _c, a = _req(gw, "POST", "/v1/sessions")
+    _c, b = _req(gw, "POST", "/v1/sessions")
+    _req(gw, "POST", f"/v1/sessions/{a['session_id']}/statements",
+         {"statement": "CREATE TABLE only_a (x BIGINT) "
+                       "WITH ('connector'='datagen')"})
+    code, out = _req(gw, "POST",
+                     f"/v1/sessions/{b['session_id']}/statements",
+                     {"statement": "SELECT * FROM only_a"})
+    assert code == 400
+    assert "only_a" in out["error"]
+
+
+def test_bad_statement_survives_session(gw):
+    _c, s = _req(gw, "POST", "/v1/sessions")
+    sid = s["session_id"]
+    code, out = _req(gw, "POST", f"/v1/sessions/{sid}/statements",
+                     {"statement": "SELEC nope"})
+    assert code == 400
+    code, out = _req(gw, "POST", f"/v1/sessions/{sid}/statements",
+                     {"statement": "SHOW TABLES"})
+    assert code == 200
+
+
+def test_unknown_session_404(gw):
+    code, _ = _req(gw, "POST", "/v1/sessions/nope/statements",
+                   {"statement": "SHOW TABLES"})
+    assert code == 404
+
+
+def test_close_session(gw):
+    _c, s = _req(gw, "POST", "/v1/sessions")
+    sid = s["session_id"]
+    code, _ = _req(gw, "DELETE", f"/v1/sessions/{sid}")
+    assert code == 200
+    code, _ = _req(gw, "GET", f"/v1/sessions/{sid}")
+    assert code == 404
