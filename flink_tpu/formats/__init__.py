@@ -4,12 +4,15 @@
 from .core import BinaryFormat, CsvFormat, Format, JsonFormat
 
 __all__ = ["Format", "CsvFormat", "JsonFormat", "BinaryFormat",
-           "ParquetFormat"]
+           "ParquetFormat", "ProtobufFormat"]
 
 
 def __getattr__(name):
-    # lazy: pyarrow only loads when parquet is actually used
+    # lazy: pyarrow/protobuf only load when actually used
     if name == "ParquetFormat":
         from .parquet import ParquetFormat
         return ParquetFormat
+    if name == "ProtobufFormat":
+        from .protobuf import ProtobufFormat
+        return ProtobufFormat
     raise AttributeError(name)

@@ -1,0 +1,179 @@
+"""Protobuf format: length-delimited messages <-> RecordBatches.
+
+Analog of flink-formats/flink-protobuf (PbRowDataDeserializationSchema /
+PbRowDataSerializationSchema): rows map to one protobuf message type.
+Two ways to bind the message type:
+
+* pass a compiled message CLASS (``message_cls``) whose field names match
+  the schema's columns — the interop path for existing .proto contracts;
+* pass nothing and a message descriptor is built DYNAMICALLY from the
+  Schema (int64 -> int64, float -> double, bool -> bool, object -> string),
+  so wire-compatible producers/consumers need only agree on the schema.
+
+Framing is the standard protobuf streaming convention: each message is
+preceded by its varint length (what parseDelimitedFrom reads), making the
+format a normal streaming block format for the file/socket connectors.
+Event timestamps ride a reserved ``__ts__`` int64 field when
+``write_timestamps`` is on.
+
+The decode path is per-message (protobuf is a row format — there is no
+columnar fast path to preserve); route bulk analytics through parquet or
+the columnar format instead, and use protobuf where the CONTRACT is
+protobuf.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.records import RecordBatch, Schema
+from .core import Format
+
+__all__ = ["ProtobufFormat"]
+
+_TS_FIELD = "__ts__"
+
+
+def _dtype_to_pb(dtype) -> int:
+    from google.protobuf import descriptor_pb2 as dp
+
+    t = dp.FieldDescriptorProto
+    if dtype is object:
+        return t.TYPE_STRING
+    d = np.dtype(dtype)
+    if d == np.bool_:
+        return t.TYPE_BOOL
+    if np.issubdtype(d, np.integer):
+        return t.TYPE_INT64
+    if np.issubdtype(d, np.floating):
+        return t.TYPE_DOUBLE
+    raise TypeError(f"no protobuf mapping for column dtype {dtype}")
+
+
+def _build_message_class(schema: Schema, with_ts: bool):
+    """Dynamic message type from the Schema (descriptor pool route)."""
+    import uuid
+
+    from google.protobuf import descriptor_pb2 as dp
+    from google.protobuf import descriptor_pool, message_factory
+
+    fd = dp.FileDescriptorProto()
+    fd.name = f"flink_tpu_dyn_{uuid.uuid4().hex}.proto"
+    fd.package = "flink_tpu.dyn"
+    msg = fd.message_type.add()
+    msg.name = "Row"
+    num = 1
+    for f in schema.fields:
+        fld = msg.field.add()
+        fld.name = f.name
+        fld.number = num
+        fld.label = dp.FieldDescriptorProto.LABEL_OPTIONAL
+        fld.type = _dtype_to_pb(f.dtype)
+        num += 1
+    if with_ts:
+        fld = msg.field.add()
+        fld.name = _TS_FIELD
+        fld.number = num
+        fld.label = dp.FieldDescriptorProto.LABEL_OPTIONAL
+        fld.type = dp.FieldDescriptorProto.TYPE_INT64
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fd)
+    desc = pool.FindMessageTypeByName("flink_tpu.dyn.Row")
+    return message_factory.GetMessageClass(desc)
+
+
+def _write_varint(n: int, out: bytearray) -> None:
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    """(value, new_pos); raises IndexError past the buffer."""
+    result = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+class ProtobufFormat(Format):
+    binary = True
+
+    def __init__(self, schema: Schema, message_cls=None,
+                 write_timestamps: bool = True):
+        self.schema = schema
+        self._write_ts = bool(write_timestamps)
+        self._cls = message_cls or _build_message_class(
+            schema, self._write_ts)
+        names = {f.name for f in self._cls.DESCRIPTOR.fields}
+        missing = [f.name for f in schema.fields if f.name not in names]
+        if missing:
+            raise ValueError(
+                f"message type {self._cls.DESCRIPTOR.full_name} lacks "
+                f"fields for columns {missing}")
+        self._has_ts = _TS_FIELD in names
+
+    # -- encode ------------------------------------------------------------
+    def encode_block(self, batch: RecordBatch) -> bytes:
+        out = bytearray()
+        cols = [(f.name, batch.columns[f.name], f.is_numeric,
+                 np.issubdtype(np.dtype(f.dtype), np.floating)
+                 if f.is_numeric else False)
+                for f in batch.schema.fields]
+        ts = batch.timestamps
+        for i in range(batch.n):
+            m = self._cls()
+            for name, col, numeric, floating in cols:
+                v = col[i]
+                if v is None:
+                    continue
+                if numeric:
+                    setattr(m, name,
+                            float(v) if floating else
+                            bool(v) if isinstance(v, np.bool_) else int(v))
+                else:
+                    setattr(m, name, str(v))
+            if self._write_ts and self._has_ts:
+                setattr(m, _TS_FIELD, int(ts[i]))
+            payload = m.SerializeToString()
+            _write_varint(len(payload), out)
+            out += payload
+        return bytes(out)
+
+    # -- decode ------------------------------------------------------------
+    def decode_block(self, data: bytes) -> tuple[list[RecordBatch], bytes]:
+        rows: list = []
+        ts: list[int] = []
+        pos = 0
+        n = len(data)
+        while pos < n:
+            try:
+                length, body = _read_varint(data, pos)
+            except IndexError:
+                break                       # partial varint: carry over
+            if body + length > n:
+                break                       # partial message
+            m = self._cls()
+            m.ParseFromString(data[body:body + length])
+            pos = body + length
+            row = []
+            for f in self.schema.fields:
+                v = getattr(m, f.name)
+                row.append(v if f.dtype is not object else (v or None))
+            rows.append(tuple(row))
+            ts.append(getattr(m, _TS_FIELD) if self._has_ts else 0)
+        if not rows:
+            return [], data[pos:]
+        batch = RecordBatch.from_rows(self.schema, rows, ts)
+        return [batch], data[pos:]

@@ -1,0 +1,86 @@
+"""Protobuf format: dynamic message types from schemas, varint-delimited
+framing, partial-frame carry-over, file round trip."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("google.protobuf")
+
+from flink_tpu.core.records import RecordBatch, Schema
+from flink_tpu.formats.protobuf import ProtobufFormat
+
+SCHEMA = Schema([("k", np.int64), ("price", np.float64), ("tag", object)])
+
+
+def _batch(n, t0=0):
+    rng = np.random.default_rng(7)
+    return RecordBatch(
+        SCHEMA,
+        {"k": rng.integers(0, 9, n).astype(np.int64),
+         "price": np.round(rng.random(n), 6),
+         "tag": np.array([f"t{i % 3}" for i in range(n)], dtype=object)},
+        np.arange(t0, t0 + n, dtype=np.int64))
+
+
+def _rows(b):
+    return [(int(b.column("k")[i]), float(b.column("price")[i]),
+             b.column("tag")[i], int(b.timestamps[i]))
+            for i in range(b.n)]
+
+
+def test_round_trip():
+    fmt = ProtobufFormat(SCHEMA)
+    b = _batch(50, t0=100)
+    blob = fmt.encode_block(b)
+    out, rest = fmt.decode_block(blob)
+    assert rest == b""
+    assert _rows(out[0]) == _rows(b)
+
+
+def test_partial_frame_carry_over():
+    fmt = ProtobufFormat(SCHEMA)
+    blob = fmt.encode_block(_batch(10))
+    cut = len(blob) - 7                   # split inside the last message
+    out1, rest = fmt.decode_block(blob[:cut])
+    assert out1 and out1[0].n == 9
+    out2, rest2 = fmt.decode_block(rest + blob[cut:])
+    assert rest2 == b"" and out2[0].n == 1
+
+
+def test_wire_compatibility_across_instances():
+    """Two independently-built dynamic types with the same schema are
+    wire compatible (field numbers derive from column order)."""
+    a, b = ProtobufFormat(SCHEMA), ProtobufFormat(SCHEMA)
+    blob = a.encode_block(_batch(5))
+    out, _ = b.decode_block(blob)
+    assert out[0].n == 5
+
+
+def test_file_source_sink_round_trip(tmp_path):
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.connectors.file import FileSink, FileSource
+    from flink_tpu.core.config import PipelineOptions
+
+    out_dir = str(tmp_path / "pb")
+    rows = [(int(i % 4), float(i) / 2, f"g{i % 3}") for i in range(200)]
+    env = StreamExecutionEnvironment()
+    env.config.set(PipelineOptions.BATCH_SIZE, 32)
+    ds = env.from_collection(rows, SCHEMA, timestamps=list(range(200)))
+    ds.sink_to(FileSink(out_dir, ProtobufFormat(SCHEMA)), "pb-sink")
+    env.execute("write-pb", timeout=120.0)
+
+    env2 = StreamExecutionEnvironment()
+    sink = CollectSink()
+    env2.from_source(FileSource(out_dir, ProtobufFormat(SCHEMA)),
+                     name="pb-src").add_sink(sink, "c")
+    env2.execute("read-pb", timeout=120.0)
+    got = sorted((int(k), round(float(p), 6), t) for k, p, t in sink.rows)
+    assert got == sorted((k, round(p, 6), t) for k, p, t in rows)
+
+
+def test_schema_mismatch_with_compiled_class():
+    other = Schema([("nope", np.int64)])
+    fmt = ProtobufFormat(SCHEMA)
+    with pytest.raises(ValueError, match="nope"):
+        ProtobufFormat(other, message_cls=fmt._cls)
