@@ -56,6 +56,32 @@ class LocalJob(TaskReporter):
         self.kv_registry = KvStateRegistry()
         from ..runtime.alignment import WatermarkAlignmentCoordinator
         self.watermark_alignment = WatermarkAlignmentCoordinator()
+        # per-attempt Execution records (reference ExecutionGraph's
+        # Execution/ExecutionAttemptID): every deployment of a task id
+        # appends one attempt with its state transitions
+        self.executions: dict[str, list[dict]] = {}
+
+    # -- execution-attempt tracking ----------------------------------------
+    def _exec_new(self, task_id: str) -> None:
+        with self._lock:
+            attempts = self.executions.setdefault(task_id, [])
+            attempts.append({"attempt": len(attempts) + 1,
+                             "state": "DEPLOYING", "start": time.time(),
+                             "end": None, "failure": None})
+
+    def _exec_set(self, task_id: str, state: str,
+                  failure: Optional[str] = None) -> None:
+        attempts = self.executions.get(task_id)
+        if not attempts:
+            return
+        rec = attempts[-1]
+        if rec["state"] in ("FINISHED", "FAILED", "CANCELED"):
+            return                      # terminal states never regress
+        rec["state"] = state
+        if state in ("FINISHED", "FAILED", "CANCELED"):
+            rec["end"] = time.time()
+        if failure is not None:
+            rec["failure"] = failure
 
     # -- TaskReporter ------------------------------------------------------
     def acknowledge_checkpoint(self, task_id: str, checkpoint_id: int,
@@ -70,12 +96,15 @@ class LocalJob(TaskReporter):
 
     def task_finished(self, task_id: str) -> None:
         with self._lock:
+            self._exec_set(task_id,
+                           "CANCELED" if self.cancelled else "FINISHED")
             self._finished.add(task_id)
             if len(self._finished) == len(self.tasks):
                 self._done.set()
 
     def task_failed(self, task_id: str, error: BaseException) -> None:
         with self._lock:
+            self._exec_set(task_id, "FAILED", failure=repr(error))
             self._failed.append((task_id, error))
             self._done.set()
 
@@ -86,8 +115,10 @@ class LocalJob(TaskReporter):
             # placement, parallelism < host count): it is trivially done
             self._done.set()
             return
-        for t in self.tasks.values():
+        for tid, t in self.tasks.items():
             t.start()
+            with self._lock:
+                self._exec_set(tid, "RUNNING")
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -178,6 +209,10 @@ def restart_region(job: "LocalJob", job_graph: JobGraph,
         t = job.tasks.pop(tid)
         job.source_tasks.pop(tid, None)
         t.cancel()
+        with job._lock:
+            # region teardown cancels the healthy region-mates of the
+            # failed task; their attempt ends CANCELED, not FINISHED
+            job._exec_set(tid, "CANCELED")
         old.append(t)
     for t in old:
         # the old attempt must fully unwind BEFORE the new one deploys:
@@ -210,6 +245,8 @@ def restart_region(job: "LocalJob", job_graph: JobGraph,
             job._done.set()
     for tid in affected:
         job.tasks[tid].start()
+        with job._lock:
+            job._exec_set(tid, "RUNNING")
     return affected
 
 
@@ -330,6 +367,7 @@ def _deploy_vertices(job: "LocalJob", job_graph: JobGraph,
                 if snapshot:
                     task.restore_state(snapshot)
             job.tasks[task_id] = task
+            job._exec_new(task_id)
 
 
 def _side_outputs_map(side_writers, metrics) -> Optional[dict[str, Output]]:

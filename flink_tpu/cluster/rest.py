@@ -60,10 +60,17 @@ class RestEndpoint:
         for vid, v in job.job_graph.vertices.items():
             subtasks = []
             for sub in range(v.parallelism):
-                t = job.tasks.get(f"{vid}#{sub}")
+                tid = f"{vid}#{sub}"
+                t = job.tasks.get(tid)
+                attempts = getattr(job, "executions", {}).get(tid, [])
+                cur = attempts[-1] if attempts else None
                 subtasks.append({
                     "subtask": sub,
-                    "state": "RUNNING" if (t and t.is_alive) else "FINISHED"})
+                    "state": (cur["state"] if cur else
+                              "RUNNING" if (t and t.is_alive)
+                              else "FINISHED"),
+                    "attempt": cur["attempt"] if cur else 1,
+                    "attempts": attempts})
             vertices.append({"id": vid, "name": v.name, "uid": v.uid,
                              "parallelism": v.parallelism,
                              "subtasks": subtasks})

@@ -128,3 +128,47 @@ def test_single_region_falls_back_to_full_restart():
         finals[k] = max(finals.get(k, 0), v)
     assert finals == {k: sum(i for i in range(n) if i % 3 == k)
                       for k in range(3)}
+
+
+def test_execution_attempt_tracking():
+    """Per-attempt Execution records (reference ExecutionGraph's
+    Execution/ExecutionAttemptID): a region restart appends a new attempt
+    with state transitions; healthy tasks keep one attempt."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.cluster.scheduler import JobSupervisor
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.config import (
+        CheckpointingOptions, PipelineOptions, RuntimeOptions,
+    )
+
+    _Bomb.armed = True
+    env = StreamExecutionEnvironment()
+    env.config.set(PipelineOptions.BATCH_SIZE, 4)
+    env.config.set(CheckpointingOptions.INTERVAL, 0.05)
+    env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
+    n = 300
+    rows = [(i % 3, i) for i in range(n)]
+    sink_a, sink_b = CollectSink(), CollectSink()
+    (env.from_collection(rows, SCHEMA, timestamps=list(range(n)),
+                         name="src-a")
+        .map(_Bomb(200), name="bomb")
+        .key_by("k").sum(1).add_sink(sink_a, "sink-a"))
+    (env.from_collection(rows, SCHEMA, timestamps=list(range(n)),
+                         name="src-b")
+        .key_by("k").sum(1).add_sink(sink_b, "sink-b"))
+    jg = env.get_job_graph("attempts")
+    sup = JobSupervisor(jg, env.config)
+    job = sup.run(timeout=120)
+    assert sup.failures
+    attempts = {tid: [a["state"] for a in recs]
+                for tid, recs in job.executions.items()}
+    # the bombed region's tasks have 2 attempts: FAILED/CANCELED then a
+    # terminal FINISHED; the healthy region's tasks exactly one
+    multi = {tid for tid, sts in attempts.items() if len(sts) == 2}
+    single = {tid for tid, sts in attempts.items() if len(sts) == 1}
+    assert multi and single
+    for tid in multi:
+        assert attempts[tid][0] in ("FAILED", "CANCELED")
+        assert attempts[tid][1] == "FINISHED"
+    for tid in single:
+        assert attempts[tid] == ["FINISHED"]
