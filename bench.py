@@ -283,7 +283,10 @@ def _run_q7(n_keys: int, n_events: int, capacity: int,
                  watermark_strategy=ws, device=True)
         .key_by("auction")
         .window(TumblingEventTimeWindows.of(pane_ms))
-        .device_aggregate([AggSpec("max", "packed", out_name="best")],
+        # packed word = (price<<20)|bidder < 2^34: value_bits tightens the
+        # fire-time radix top-k to 3 histogram passes
+        .device_aggregate([AggSpec("max", "packed", out_name="best",
+                                   value_bits=34)],
                           capacity=capacity, ring_size=RING,
                           emit_window_bounds=True, emit_topk=1,
                           defer_overflow=True, async_fire=True)
