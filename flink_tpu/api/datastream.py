@@ -47,6 +47,13 @@ def make_key_extractor(key: KeySpec):
         return extract_col
 
     fn = key
+    if getattr(fn, "vectorized", False):
+        # already a batch-level extractor (RecordBatch -> ndarray); routing
+        # then hashes the array it returns, so a caller that needs exchange
+        # routing to agree with a backend's own key hashing (the device
+        # GROUP BY combined-word keys) can guarantee it by returning the
+        # exact key array the backend stores
+        return fn
 
     def extract_fn(batch: RecordBatch) -> np.ndarray:
         return np.array([fn(r) for r in batch.iter_rows()], dtype=object)

@@ -491,10 +491,25 @@ class _Planner:
             keyed = ds.key_by(lambda row: 0)
         elif len(key_names) == 1:
             keyed = ds.key_by(key_names[0])
+        elif use_device:
+            # route by the SAME combined int64 word the device backend
+            # stores: DeviceGroupAggOperator's TpuKeyedStateBackend
+            # snapshots key groups from hash_batch(combine_key_columns(...)),
+            # so the exchange must hash that word too — hashing the Python
+            # tuple instead would restore each group's state onto a subtask
+            # that never receives its records (silent state loss at
+            # parallelism > 1)
+            from .device_group_agg import combine_key_columns
+
+            def _combined(batch, _names=tuple(key_names)):
+                return combine_key_columns(
+                    [np.asarray(batch.column(n)) for n in _names])
+            _combined.vectorized = True
+            keyed = ds.key_by(_combined)
         else:
             # the local combine keeps key columns first in ITS output
             key_idx = (tuple(range(len(key_names)))
-                       if two_phase and not use_device
+                       if two_phase
                        else tuple(pre_schema.index_of(n)
                                   for n in key_names))
             keyed = ds.key_by(
