@@ -84,6 +84,12 @@ def masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
         passes = 4
     else:
         passes = max(1, -(-value_bits // 16))
+    if passes <= 2 and not jnp.issubdtype(jnp.asarray(values).dtype,
+                                          jnp.floating):
+        # non-negative integers below 2^32: the whole walk fits a uint32
+        # word — half the memory traffic of the uint64 path on every
+        # histogram/compare (the select is memory-bound at large n)
+        return _masked_topk_radix32(values, valid, k, passes)
     return _masked_topk_radix(values, valid, k, passes)
 
 
@@ -144,6 +150,54 @@ def _masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
                                    jnp.uint64(0)),
                          filled))[::-1]
     return buf_v[order], jnp.maximum(buf_i, 0)[order], filled[order]
+
+
+@partial(jax.jit, static_argnames=("k", "passes"))
+def _masked_topk_radix32(values: jax.Array, valid: jax.Array, k: int,
+                         passes: int = 2):
+    """uint32 radix walk for non-negative integer domains below 2^32
+    (value_bits <= 32): same threshold-select algorithm as the 64-bit
+    path, but every O(n) pass touches half the bytes, and the index
+    compaction runs in int32 (n < 2^31 always holds — capacities are
+    device-array sized)."""
+    n = values.shape[0]
+    k = min(k, n)
+    u = values.astype(jnp.uint32)
+    nvalid = jnp.sum(valid, dtype=jnp.int32)
+    kk = jnp.minimum(jnp.int32(k), nvalid)
+    cand = valid
+    above = jnp.int32(0)
+    prefix = jnp.uint32(0)
+    bins = jnp.arange(65536, dtype=jnp.int32)
+    for shift in (16, 0)[2 - passes:]:
+        field = ((u >> shift) & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        hist = jnp.zeros(65536, jnp.int32).at[field].add(
+            cand.astype(jnp.int32))
+        revcum = jnp.cumsum(hist[::-1])[::-1]
+        cond = (above + revcum) >= kk
+        bstar = jnp.max(jnp.where(cond, bins, -1))
+        above = above + jnp.where(bins > bstar, hist, 0).sum()
+        prefix = prefix | (bstar.astype(jnp.uint32) << shift)
+        cand = cand & (field == bstar)
+    thr = prefix
+    strict = valid & (u > thr)
+    tie = valid & (u == thr)
+    cum_s = jnp.cumsum(strict.astype(jnp.int32))
+    cum_t = jnp.cumsum(tie.astype(jnp.int32))
+    tie_pos = jnp.clip(jnp.int32(k) - cum_t, 0, k - 1)
+    strict_pos = cum_s - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    buf_i = jnp.full(k, -1, jnp.int32)
+    buf_i = buf_i.at[jnp.where(tie, tie_pos, k)].set(idx, mode="drop")
+    buf_i = buf_i.at[jnp.where(strict, strict_pos, k)].set(idx, mode="drop")
+    filled = buf_i >= 0
+    sent = _sentinel(values.dtype)
+    buf_v = jnp.where(filled, values[jnp.maximum(buf_i, 0)], sent)
+    order = jnp.lexsort((jnp.where(filled, buf_v.astype(jnp.uint32),
+                                   jnp.uint32(0)),
+                         filled))[::-1]
+    return (buf_v[order], jnp.maximum(buf_i, 0)[order].astype(jnp.int64),
+            filled[order])
 
 
 def _sentinel(dtype):

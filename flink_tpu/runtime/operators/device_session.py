@@ -72,7 +72,7 @@ def _sess_step(fold_sig: tuple, lanes: int, gap: int, dirty_block: int):
     from ...ops.segment_ops import scatter_fold
 
     L = lanes
-    donate = (0, 1, 2, 3, 4, 5) if jax.default_backend() != "cpu" else ()
+    donate = (0, 1, 2, 3, 4, 5)
 
     @partial(jax.jit, donate_argnums=donate)
     def step(table, planes, cur_lane, dropped, late, dirty, keys, ts, cols,
@@ -326,9 +326,13 @@ class DeviceSessionWindowOperator(OneInputOperator):
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         super().setup(ctx, output)
+        # host_index=False: the fused session program inserts into the
+        # table with the XLA probe itself; the native dense-slot allocator
+        # must not also hand out slots for this table (a restored key
+        # would sit at a dense slot the probe never visits)
         self._backend = TpuKeyedStateBackend(
             ctx.key_group_range, ctx.max_parallelism,
-            capacity=self._capacity)
+            capacity=self._capacity, host_index=False)
         L = self._lanes
         self._backend.register_array_state("__start__", "min", jnp.int64,
                                            ring=L)

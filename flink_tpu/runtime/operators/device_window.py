@@ -102,8 +102,7 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
     from ...ops.segment_ops import scatter_fold
 
     spill = spill_maxp > 0
-    donate = ((0, 1, 2, 3, 4, 5, 6) if spill else (0, 1, 2, 3, 4)) \
-        if jax.default_backend() != "cpu" else ()
+    donate = (0, 1, 2, 3, 4, 5, 6) if spill else (0, 1, 2, 3, 4)
 
     @partial(jax.jit, donate_argnums=donate)
     def step_fn(table, arrays, dropped, late, dirty, stage, touch, keys, ts,
@@ -154,7 +153,7 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
         out = dict(arrays)
         out["__count__"] = scatter_fold(
             "count", count.reshape(-1), flat,
-            jnp.ones(keys.shape[0], jnp.int64), ok).reshape(count.shape)
+            jnp.ones(keys.shape[0], count.dtype), ok).reshape(count.shape)
         for kind, name, field in fold_sig:
             arr = arrays[name]
             vals = cols[field].astype(arr.dtype)
@@ -162,9 +161,41 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
                                      ok).reshape(arr.shape)
         # incremental-snapshot capture: mark touched dirty blocks
         dirty = dirty.at[jnp.maximum(slots, 0) // dirty_block].set(True)
-        return table, out, dropped, late, dirty, stage, touch
+        # completion token: a fresh scalar buffer that is NEVER fed back
+        # into a donated argument, so the host can block on it to bound
+        # the in-flight backlog (every other output becomes a donated
+        # input of the next step and would be a deleted buffer by then)
+        token = late + dropped
+        return table, out, dropped, late, dirty, stage, touch, token
 
     return step_fn
+
+
+@functools.lru_cache(maxsize=128)
+def _native_fold_program(fold_sig: tuple, dirty_block: int):
+    """CPU-fallback companion of _step_program: slots come from the native
+    host index (backend.native_slots), so this program is only the scatter
+    folds + dirty marking, donated for in-place plane updates. Returns a
+    fresh completion token for the in-flight backpressure window."""
+    from ...ops.segment_ops import scatter_fold
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def fold(arrays, dirty, flat, slots, valid, vals):
+        count = arrays["__count__"]
+        out = dict(arrays)
+        out["__count__"] = scatter_fold(
+            "count", count.reshape(-1), flat,
+            jnp.ones(flat.shape[0], count.dtype), valid).reshape(count.shape)
+        for i, (kind, name, _field) in enumerate(fold_sig):
+            arr = arrays[name]
+            out[name] = scatter_fold(kind, arr.reshape(-1), flat,
+                                     vals[i].astype(arr.dtype),
+                                     valid).reshape(arr.shape)
+        dirty = dirty.at[jnp.maximum(slots, 0) // dirty_block].set(True)
+        token = jnp.sum(valid.astype(jnp.int64))
+        return out, dirty, token
+
+    return fold
 
 
 @functools.lru_cache(maxsize=128)
@@ -300,6 +331,16 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         if self._async:
             self._record_fire_latency = False
         self._init_async_fires()
+        # bounded in-flight window: the host thread can dispatch an entire
+        # bounded stream into the device queue before the first program
+        # retires, which pushes every queued fire's completion (and its
+        # latency) to the end of the run. Holding a small deque of step
+        # outputs and blocking on the (k-2)th before admitting batch k
+        # keeps the device fed while capping the backlog — p99 fire
+        # latency then tracks the per-batch service time instead of the
+        # job tail.
+        self._inflight: deque = deque()
+        self._max_inflight = 2
         self._fire_fn = None
         self._out_schema: Optional[Schema] = None
         self._late_dev = None  # device late-drop counter (device ingest)
@@ -319,7 +360,16 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             ctx.key_group_range, ctx.max_parallelism,
             capacity=self._capacity, defer_overflow=self._defer,
             hbm_budget_slots=budget)
-        self._backend.register_array_state("__count__", "count", jnp.int64,
+        # count-plane width follows the declared result bound: a COUNT
+        # aggregate with value_bits <= 31 promises every per-window count
+        # fits int32, which halves the fold scatter + fire merge traffic
+        # on the [ring, capacity] plane (the whole-capacity passes are the
+        # memory-bound cost at 10M+ keys) and feeds the uint32 radix
+        # select directly
+        cvb = min((a.value_bits for a in self._aggs if a.kind == "count"),
+                  default=64)
+        count_dtype = jnp.int32 if cvb <= 31 else jnp.int64
+        self._backend.register_array_state("__count__", "count", count_dtype,
                                            ring=self._ring)
         self._registered = False
 
@@ -369,7 +419,16 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                     "state backend for float/string keys")
             self._register_aggs(batch.schema)
         t0 = time.perf_counter()
-        if (isinstance(batch, DeviceRecordBatch) and self._defer
+        if self._backend.host_index_active:
+            # CPU fallback: slot resolution through the native host index
+            # (the "device" IS the host — see TpuKeyedStateBackend
+            # .native_slots); pane bookkeeping + late filter run in the
+            # shared control plane, folds stay donated XLA programs
+            hb = self._host_view(batch)
+            keys = np.asarray(hb.column(self._key_column)).astype(
+                np.int64, copy=False)
+            self._ingest(hb, keys)
+        elif (isinstance(batch, DeviceRecordBatch) and self._defer
                 and batch.dtimestamps is not None):
             self._ingest_device(batch)
         elif self._spill_deferred:
@@ -456,7 +515,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
 
         cols = {f: _pad(batch.device_column(f)) for _k, _n, f in sig}
         fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
-        table, new_arrays, dropped, late, dirty, stage, touch = step(
+        table, new_arrays, dropped, late, dirty, stage, touch, token = step(
             self._backend.table, arrays, self._backend.dropped_device,
             self._late_dev, self._backend.dirty_mask,
             self._stage if spill else None,
@@ -475,6 +534,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         if spill:
             self._stage = stage
             self._backend.set_touch_device(touch)
+        self._admit_token(token)
 
     def _alloc_stage(self) -> None:
         S = self._stage_slots
@@ -511,8 +571,69 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         # write position alone
         self._stage["count"] = jnp.zeros((), jnp.int64)
 
+    def _host_view(self, batch) -> RecordBatch:
+        """A host-column view of a batch (CPU fallback: device arrays ARE
+        host buffers, so np.asarray is a view, not a transfer)."""
+        if isinstance(batch, DeviceRecordBatch):
+            cols = {f.name: np.asarray(batch.device_column(f.name))
+                    for f in batch.schema.fields}
+            ts = np.asarray(batch.dtimestamps
+                            if batch.dtimestamps is not None
+                            else batch.timestamps)
+            return RecordBatch(batch.schema, cols, ts)
+        return batch
+
+    def _fold_native(self, batch: RecordBatch, keys: np.ndarray,
+                     panes: np.ndarray) -> None:
+        """CPU-fallback fold: native host-index slot resolution + ONE
+        donated XLA fold program over all aggregates. The C++ probe beats
+        the XLA probe loop ~15x on host cores (see backend.native_slots);
+        the scatter folds stay XLA (donated, in-place)."""
+        backend = self._backend
+        slots = backend.native_slots(keys)
+        cap = backend.capacity
+        flat = (panes % self._ring).astype(np.int64) * np.int64(cap) \
+            + slots.astype(np.int64)
+        from ...ops.segment_ops import pow2_ceil
+
+        n = batch.n
+        P = pow2_ceil(n)
+
+        def _pad(a: np.ndarray, fill) -> np.ndarray:
+            if P == n:
+                return a
+            return np.concatenate([a, np.full(P - n, fill, a.dtype)])
+
+        sig = self._fold_sig()
+        vals = tuple(jnp.asarray(_pad(np.asarray(batch.column(f)), 0))
+                     for _k, _n, f in sig)
+        valid = jnp.asarray(_pad(np.ones(n, bool), False))
+        arrays = {name: backend.get_array(name)
+                  for name in self._fire_array_names()}
+        prog = _native_fold_program(sig, backend.dirty_block_size)
+        out, dirty, token = prog(
+            arrays, backend.dirty_mask, jnp.asarray(_pad(flat, 0)),
+            jnp.asarray(_pad(slots, np.int32(0))), valid, vals)
+        for name, a in out.items():
+            backend.set_array(name, a)
+        backend.set_dirty_mask(dirty)
+        self._admit_token(token)
+
+    def _admit_token(self, token) -> None:
+        """Bounded in-flight window shared by the device and native ingest
+        paths: block on the (k - max_inflight)th step's completion token
+        before admitting more work, then drain any landed fires."""
+        self._inflight.append(token)
+        if len(self._inflight) > self._max_inflight:
+            jax.block_until_ready(self._inflight.popleft())
+            if self._pending:
+                self._drain(block=False)
+
     def _fold(self, batch: RecordBatch, keys: np.ndarray,
               panes: np.ndarray) -> None:
+        if self._backend.host_index_active:
+            self._fold_native(batch, keys, panes)
+            return
         if self._defer:
             # pipelined path: host<->device calls have a large fixed cost
             # (the chip may sit behind a network tunnel), so the whole
@@ -683,9 +804,14 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             cols["window_start"] = np.full(n, start, np.int64)
             cols["window_end"] = np.full(n, end, np.int64)
             fields += [("window_start", np.int64), ("window_end", np.int64)]
-        for name, vals in results.items():
-            cols[name] = vals
-            fields.append((name, vals.dtype.type))
+        # emit in AggSpec declaration order — the fire program's results
+        # ride a jax pytree, which canonicalizes dict keys to SORTED
+        # order, so iterating `results` directly would emit columns
+        # alphabetically instead of as the user declared them
+        for a in self._aggs:
+            vals = results[a.out_name]
+            cols[a.out_name] = vals
+            fields.append((a.out_name, vals.dtype.type))
         schema = Schema(fields)
         ts = np.full(n, end - 1, np.int64)
         self.output.emit(RecordBatch(schema, cols, ts))

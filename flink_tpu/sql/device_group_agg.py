@@ -73,7 +73,7 @@ def _gagg_program(fold_sig: tuple, dirty_block: int):
     fold_kind in sum|min|max and col_index indexes the stacked value
     columns (-1 = fold the sign itself, for COUNT slots)."""
 
-    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    donate = (0, 1)
 
     @partial(jax.jit, donate_argnums=donate)
     def step(planes: dict, dirty, slots, sign, vals, n_valid):

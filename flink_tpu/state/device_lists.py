@@ -25,6 +25,7 @@ retention window (watermark pruning bounds live rows).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -67,12 +68,17 @@ def _append_prog(table, rows, counts, keys, packed):
 
 
 @jax.jit
-def _probe_prog(table, rows, counts, keys):
+def _probe_slots(table, counts, keys):
     keys = sanitize_keys_device(keys)
     slots = lookup(table, keys)
     found = slots >= 0
     sc = jnp.maximum(slots, 0)
-    return rows[sc], jnp.where(found, counts[sc], 0)
+    return sc, jnp.where(found, counts[sc], 0)
+
+
+@partial(jax.jit, static_argnames=("l_eff",))
+def _probe_gather(rows, sc, l_eff):
+    return rows[sc, :l_eff, :]
 
 
 @jax.jit
@@ -169,12 +175,24 @@ class DeviceListStore:
 
     def probe_batch(self, keys: np.ndarray
                     ) -> tuple[np.ndarray, np.ndarray]:
-        """(packed rows [B, L, C], counts [B]) for a batch of keys — one
-        lookup + one transfer. Mask positions >= counts[b] yourself."""
-        rows, counts = _probe_prog(self.table, self.rows, self.counts,
-                                   jnp.asarray(np.asarray(keys, np.int64)))
-        rows, counts = jax.device_get((rows, counts))
-        return np.asarray(rows), np.asarray(counts)
+        """(packed rows [B, L_eff, C], counts [B]) for a batch of keys.
+        L_eff is the batch's max list length rounded up to a power of two
+        (one cached gather program per bucket) — probing keys whose lists
+        hold a handful of rows must not gather-and-transfer the full
+        [B, rows_per_key, C] block (measured 134 MB/batch in the Q7 join
+        at rows_per_key=256 when live lists held <= 4 rows). Mask
+        positions >= counts[b] yourself."""
+        from ..ops.segment_ops import pow2_ceil
+
+        sc, cnt = _probe_slots(self.table, self.counts,
+                               jnp.asarray(np.asarray(keys, np.int64)))
+        counts = np.asarray(jax.device_get(cnt))
+        mx = int(counts.max()) if len(counts) else 0
+        if mx == 0:
+            return np.zeros((len(counts), 0, self.C), np.int64), counts
+        l_eff = min(pow2_ceil(mx), self.L)
+        rows = jax.device_get(_probe_gather(self.rows, sc, l_eff))
+        return np.asarray(rows), counts
 
     def prune(self, horizon: int) -> None:
         """Drop every row with ts < horizon (watermark cleanup) — one
