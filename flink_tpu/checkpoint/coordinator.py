@@ -162,15 +162,28 @@ class CheckpointCoordinator:
     def _on_ack(self, task_id: str, checkpoint_id: int, snapshot: dict) -> None:
         """reference receiveAcknowledgeMessage:1202."""
         complete = None
+        notify_stale = False
         with self._lock:
             p = self._pending.get(checkpoint_id)
             if p is None or p.declined:
-                return
-            p.acks[task_id] = snapshot
-            expected = p.expected or frozenset(self.job.tasks)
-            if set(p.acks) >= set(expected):
-                del self._pending[checkpoint_id]
-                complete = p
+                notify_stale = not any(c.checkpoint_id == checkpoint_id
+                                       for c in self._completed)
+            else:
+                p.acks[task_id] = snapshot
+                expected = p.expected or frozenset(self.job.tasks)
+                if set(p.acks) >= set(expected):
+                    del self._pending[checkpoint_id]
+                    complete = p
+        if notify_stale:
+            # a snapshot for an ABANDONED checkpoint just landed: the
+            # task's barrier was still in the data channel when the abort
+            # broadcast ran (a no-op for it — nothing was pinned yet), so
+            # its freshly-registered generation pin would leak forever.
+            # Re-broadcast the abort now that the late snapshot exists
+            # (reference: late acks for disposed checkpoints get discard
+            # callbacks the same way).
+            self._notify_aborted(checkpoint_id)
+            return
         if complete is not None:
             self._complete(complete)
 
@@ -180,6 +193,10 @@ class CheckpointCoordinator:
         if p is not None:
             p.declined = True
             p.done.set()
+            # tasks that already snapshotted this id hold generation pins
+            # (changelog DSTL); a declined checkpoint is abandoned exactly
+            # like a timed-out one and must release them
+            self._notify_aborted(checkpoint_id)
 
     def _complete(self, p: _Pending) -> None:
         if p.is_savepoint:
@@ -233,10 +250,25 @@ class CheckpointCoordinator:
         complete and must not complete PARTIALLY either."""
         with self._lock:
             self._paused = True
+            aborted = list(self._pending)
             for cid, p in list(self._pending.items()):
                 p.declined = True
                 p.done.set()
                 del self._pending[cid]
+        for cid in aborted:
+            self._notify_aborted(cid)
+
+    def _notify_aborted(self, checkpoint_id: int) -> None:
+        """Tell every task an in-flight checkpoint can no longer complete,
+        so backends drop its pins (the changelog DSTL pins a generation
+        per triggered snapshot; without an explicit abort a still-running
+        savepoint's pin could only be inferred — and mis-inferred — from
+        checkpoint-id distance)."""
+        for t in self.job.tasks.values():
+            t.execute_in_mailbox(
+                lambda t=t, c=checkpoint_id:
+                t.chain.notify_checkpoint_aborted(c)
+                if getattr(t, "chain", None) else None)
 
     def resume(self) -> None:
         with self._lock:
@@ -260,14 +292,18 @@ class CheckpointCoordinator:
             if self._paused:
                 continue
             now = time.time()
+            timed_out = []
             with self._lock:
                 # abort timed-out pendings
                 for cid, p in list(self._pending.items()):
                     if now - p.started > self.timeout:
                         del self._pending[cid]
                         p.done.set()
+                        timed_out.append(cid)
                 in_flight = len(self._pending)
                 too_soon = now - self._last_complete_time < self.min_pause
+            for cid in timed_out:
+                self._notify_aborted(cid)
             if in_flight >= self.config.get(
                     CheckpointingOptions.MAX_CONCURRENT) or too_soon:
                 continue

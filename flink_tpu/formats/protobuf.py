@@ -123,6 +123,20 @@ class ProtobufFormat(Format):
                 f"message type {self._cls.DESCRIPTOR.full_name} lacks "
                 f"fields for columns {missing}")
         self._has_ts = _TS_FIELD in names
+        # per-field decode mode, resolved ONCE (the decode loop runs per
+        # message): "presence" — object column whose field tracks explicit
+        # presence (proto2 optional / proto3 `optional`): unset -> None,
+        # present '' stays ''. "legacy" — object column WITHOUT presence
+        # (plain proto3 string from a user-supplied class): '' -> None,
+        # the best available approximation (unset and '' are identical on
+        # the wire there). "plain" — non-object columns pass through.
+        def _mode(f):
+            if f.dtype is not object:
+                return "plain"
+            fd = self._cls.DESCRIPTOR.fields_by_name[f.name]
+            return "presence" if fd.has_presence else "legacy"
+
+        self._decode_modes = [(f.name, _mode(f)) for f in schema.fields]
 
     # -- encode ------------------------------------------------------------
     def encode_block(self, batch: RecordBatch) -> bytes:
@@ -168,9 +182,14 @@ class ProtobufFormat(Format):
             m.ParseFromString(data[body:body + length])
             pos = body + length
             row = []
-            for f in self.schema.fields:
-                v = getattr(m, f.name)
-                row.append(v if f.dtype is not object else (v or None))
+            for name, mode in self._decode_modes:
+                if mode == "presence" and not m.HasField(name):
+                    row.append(None)
+                elif mode == "legacy":
+                    v = getattr(m, name)
+                    row.append(v or None)
+                else:
+                    row.append(getattr(m, name))
             rows.append(tuple(row))
             ts.append(getattr(m, _TS_FIELD) if self._has_ts else 0)
         if not rows:

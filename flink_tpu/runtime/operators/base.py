@@ -290,6 +290,10 @@ class OperatorChain:
             op.notify_checkpoint_complete(checkpoint_id,
                                           is_savepoint=is_savepoint)
 
+    def notify_checkpoint_aborted(self, checkpoint_id: int) -> None:
+        for op in self.operators:
+            op.notify_checkpoint_aborted(checkpoint_id)
+
     def finish(self) -> None:
         for op in self.operators:
             op.finish()

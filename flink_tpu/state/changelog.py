@@ -136,9 +136,13 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
         self._checkpoints_since_mat += 1
         segments = self._writer.persist(self._base_seq)
         self._ckpt_gen[checkpoint_id] = self._mat_id
-        if len(self._ckpt_gen) > 1024:      # aborted ids never notified
-            for cid in sorted(self._ckpt_gen)[:-1024]:
-                del self._ckpt_gen[cid]
+        # entries are released ONLY by explicit complete/abort
+        # notifications (the coordinator notifies timeouts, declines, and
+        # region-restart pauses) — never trimmed by id distance or count,
+        # which would drop a still-running savepoint's generation pin and
+        # let subsumption delete its base/segments (ADVICE r4). A
+        # coordinator crash clears pins implicitly: tasks restart with a
+        # freshly restored backend.
         return {"kind": "changelog-dstl",
                 "driver": self._store.driver,
                 "base": self._base_location,
@@ -168,11 +172,12 @@ class ChangelogKeyedStateBackend(HeapKeyedStateBackend):
         min_live_gen = min(g for _cid, g in self._completed_gens)
         # in-flight snapshots (triggered, not yet completed/aborted) pin
         # their generation too: a slower concurrent checkpoint may still
-        # complete after this one. Entries far below the completed id can
-        # no longer complete (outside any concurrency window) — drop them
-        # so abandoned triggers don't pin truncation forever.
-        for cid in [c for c in self._ckpt_gen if c < checkpoint_id - 64]:
-            del self._ckpt_gen[cid]
+        # complete after this one. Abandoned triggers are cleaned by the
+        # coordinator's explicit abort notifications (timeouts and
+        # region-restart pauses both call notify_checkpoint_aborted) —
+        # NOT inferred from checkpoint-id distance, which would also drop
+        # a still-running savepoint's pin and let subsumption delete the
+        # base/segments out from under savepoint_self_contained.
         if self._ckpt_gen:
             min_live_gen = min(min_live_gen, min(self._ckpt_gen.values()))
         keep = []

@@ -84,3 +84,20 @@ def test_schema_mismatch_with_compiled_class():
     fmt = ProtobufFormat(SCHEMA)
     with pytest.raises(ValueError, match="nope"):
         ProtobufFormat(other, message_cls=fmt._cls)
+
+
+def test_unset_vs_empty_string_presence():
+    """ADVICE r4: unset nullable fields decode as None; a PRESENT empty
+    string stays '' (previously `v or None` conflated the two)."""
+    fmt = ProtobufFormat(SCHEMA)
+    b = RecordBatch(
+        SCHEMA,
+        {"k": np.array([1, 2], np.int64),
+         "price": np.array([0.5, 1.5]),
+         "tag": np.array([None, ""], dtype=object)},
+        np.array([10, 11], np.int64))
+    out, rest = fmt.decode_block(fmt.encode_block(b))
+    assert rest == b""
+    tags = list(out[0].column("tag"))
+    assert tags[0] is None          # unset -> None
+    assert tags[1] == ""            # present empty string stays ''

@@ -515,3 +515,40 @@ def test_savepoint_completion_does_not_evict_checkpoint_pin(tmp_path):
     b2.restore([s5])
     b2.set_current_key(7)
     assert b2.get_partitioned_state(desc).value() == 14
+
+
+def test_slow_savepoint_pin_survives_many_checkpoints(tmp_path):
+    """ADVICE r4 low #3: a still-running savepoint triggered long ago must
+    keep its generation pinned while ordinary checkpoints complete far
+    past it (previously pins aged out by checkpoint-id distance at 64 and
+    subsumption could delete the savepoint's base/segments). Explicit
+    abort notifications — not id distance — are what release a pin now."""
+    from flink_tpu.state.dstl import FsChangelogStorage
+
+    b = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                                   materialization_interval=1)
+    b._store = FsChangelogStorage(str(tmp_path))
+    b._writer.store = b._store
+    desc = ValueStateDescriptor("x")
+    for i in range(30):
+        put(b, i, i, desc)
+    sp = b.snapshot(1)                       # the savepoint trigger
+    # 100 ordinary checkpoints trigger AND complete; cids run far past
+    # the savepoint's id + the old 64-wide inference window
+    for cid in range(2, 102):
+        put(b, cid, cid, desc)
+        b.snapshot(cid)
+        b.notify_checkpoint_complete(cid)
+    # the savepoint's generation must still be restorable
+    b2 = ChangelogKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    b2._store = FsChangelogStorage(str(tmp_path))
+    b2._writer.store = b2._store
+    b2.restore([sp])
+    b2.set_current_key(7)
+    assert b2.get_partitioned_state(desc).value() == 7
+    # once the savepoint completes, its pin releases without touching
+    # regular retention
+    b.notify_checkpoint_complete(1, is_savepoint=True)
+    # an explicit abort releases a pin too (coordinator timeout path)
+    b.snapshot(200)
+    b.notify_checkpoint_aborted(200)
