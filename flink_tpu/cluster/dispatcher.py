@@ -70,9 +70,13 @@ class Dispatcher:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  archive_dir: Optional[str] = None,
-                 job_timeout_s: float = 3600.0):
+                 job_timeout_s: float = 3600.0, config=None):
+        from ..utils import auth
+
         self._host = host
         self._requested_port = port
+        self._secret = auth.resolve_secret(config)
+        auth.check_bind(host, self._secret, "Dispatcher")
         self.archive_dir = archive_dir
         self.job_timeout_s = job_timeout_s
         self._jobs: dict[str, _JobRun] = {}
@@ -196,6 +200,14 @@ class Dispatcher:
                 parts = [p for p in self.path.split("/") if p]
                 try:
                     if parts == ["jobs"]:
+                        from ..utils import auth as _auth
+                        # token check precedes the unpickle: job
+                        # submission bodies are cloudpickle (code)
+                        if not _auth.token_ok(
+                                self.headers.get(_auth.HTTP_HEADER),
+                                dispatcher._secret):
+                            self._reply(403, {"error": "bad cluster token"})
+                            return
                         n = int(self.headers.get("Content-Length", 0))
                         payload = _pickle.loads(self.rfile.read(n))
                         jg, config = payload[0], payload[1]
@@ -260,8 +272,9 @@ class ClusterClient:
     """Submit locally-built pipelines to a running Dispatcher
     (reference RestClusterClient)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, config=None):
         self.address = address
+        self._config = config
 
     def _url(self, path: str) -> str:
         return f"http://{self.address}{path}"
@@ -287,8 +300,13 @@ class ClusterClient:
 
     def _post(self, path: str, body: bytes = b"") -> dict:
         import urllib.error
+
+        from ..utils import auth
         req = urllib.request.Request(self._url(path), data=body,
                                      method="POST")
+        secret = auth.resolve_secret(self._config)
+        if secret:
+            req.add_header(auth.HTTP_HEADER, secret)
         try:
             with urllib.request.urlopen(req, timeout=60) as r:
                 return json.loads(r.read().decode())

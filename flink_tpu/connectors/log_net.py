@@ -56,8 +56,12 @@ class LogBrokerServer:
                 "create_topic"}
 
     def __init__(self, backing: Optional[LogBroker] = None, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", config=None):
+        from ..utils import auth
+
         self.broker = backing or InMemoryLogBroker()
+        self._secret = auth.resolve_secret(config)
+        auth.check_bind(host, self._secret, "LogBrokerServer")
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -85,7 +89,13 @@ class LogBrokerServer:
                              name="log-broker-conn", daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..utils import auth
+
         try:
+            # the auth preamble precedes the FIRST pickle read: a caller
+            # without the cluster secret never reaches pickle.loads
+            if not auth.recv_hello(conn, self._secret):
+                return
             while not self._stop.is_set():
                 msg = _recv(conn)
                 if msg is None:
@@ -136,18 +146,25 @@ class RemoteLogBroker(LogBroker):
     after ANY send/recv failure the connection may hold a stale response —
     it is closed immediately and the next call reconnects fresh."""
 
-    def __init__(self, address: str, connect_timeout: float = 5.0):
+    def __init__(self, address: str, connect_timeout: float = 5.0,
+                 config=None):
+        from ..utils import auth
+
         self._address = address
         self._connect_timeout = connect_timeout
+        self._secret = auth.resolve_secret(config)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._connect()
 
     def _connect(self) -> None:
+        from ..utils import auth
+
         host, port = self._address.rsplit(":", 1)
         self._sock = socket.create_connection(
             (host, int(port)), timeout=self._connect_timeout)
         self._sock.settimeout(30.0)
+        auth.send_hello(self._sock, self._secret)
 
     def _call(self, method: str, *args):
         with self._lock:

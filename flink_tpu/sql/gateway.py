@@ -18,9 +18,14 @@ folded) — the micro-batch model makes bounded SQL complete quickly, so
 the reference's operation-handle polling collapses to one round trip.
 Statement errors return 400 with the message; the session survives.
 
-The transport carries only JSON (no pickle): unlike the intra-cluster
-control sockets, the gateway is safe to expose beyond the trust boundary
-(rows are rendered to JSON-safe scalars).
+The transport carries only JSON — no pickle deserialization on this
+surface (rows are rendered to JSON-safe scalars). That removes the
+remote-code-execution vector of the intra-cluster control sockets, but it
+does NOT make the gateway safe to expose: every caller gets full
+unauthenticated SQL, and DDL can create filesystem-connector tables —
+i.e. arbitrary file read/write as the server user. Keep the default
+loopback bind; a non-loopback deployment needs authentication or
+network-level access control in front.
 """
 
 from __future__ import annotations

@@ -61,8 +61,12 @@ class KvStateServer:
     KvStateServerImpl: one server per TaskExecutor; here one per job)."""
 
     def __init__(self, registry: KvStateRegistry, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", config=None):
+        from ..utils import auth
+
         self.registry = registry
+        self._secret = auth.resolve_secret(config)
+        auth.check_bind(host, self._secret, "KvStateServer")
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -97,7 +101,12 @@ class KvStateServer:
                              name="kvstate-conn", daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
+        from ..utils import auth
+
         try:
+            # auth preamble precedes the first pickle read
+            if not auth.recv_hello(conn, self._secret):
+                return
             while not self._stop.is_set():
                 msg = _recv(conn)
                 if msg is None:
@@ -145,18 +154,25 @@ class RemoteQueryableStateClient:
     """Network twin of QueryableStateClient (reference
     QueryableStateClient.getKvState over the KvStateServer)."""
 
-    def __init__(self, address: str, connect_timeout: float = 5.0):
+    def __init__(self, address: str, connect_timeout: float = 5.0,
+                 config=None):
+        from ..utils import auth
+
         self._address = address
         self._timeout = connect_timeout
+        self._secret = auth.resolve_secret(config)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._connect()
 
     def _connect(self) -> None:
+        from ..utils import auth
+
         host, port = self._address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=self._timeout)
         self._sock.settimeout(30.0)
+        auth.send_hello(self._sock, self._secret)
 
     def _call(self, msg: tuple) -> Any:
         with self._lock:

@@ -1,0 +1,116 @@
+"""Cluster shared-secret authentication for pickle-bearing endpoints.
+
+The dispatcher's job-submission route, the network log broker, and the
+queryable-state server all deserialize pickle received from a socket —
+which is code execution in the sender's favor. Matching the reference's
+trust model (internal RPC authenticated and fenced; see
+SecurityOptions.java and the blob-server secret), every such endpoint:
+
+* resolves a cluster secret from ``security.cluster-secret`` or the
+  ``FLINK_TPU_CLUSTER_SECRET`` environment variable;
+* REFUSES to bind a non-loopback interface without one (and warns even
+  with one — pickle endpoints should also sit behind network controls);
+* requires the secret before the first unpickle: socket protocols carry a
+  fixed preamble frame per connection, HTTP carries the
+  ``X-Flink-Tpu-Token`` header per request. Comparison is constant-time.
+
+Loopback binds with no secret configured skip enforcement — same-host
+processes could already debug each other; the boundary being defended is
+the network one.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import socket
+import struct
+import warnings
+from typing import Optional
+
+__all__ = [
+    "ENV_VAR", "HTTP_HEADER", "resolve_secret", "is_loopback",
+    "check_bind", "send_hello", "recv_hello", "token_ok",
+]
+
+ENV_VAR = "FLINK_TPU_CLUSTER_SECRET"
+HTTP_HEADER = "X-Flink-Tpu-Token"
+_MAGIC = b"FTA1"
+_HELLO = struct.Struct("<4sH")
+_MAX_TOKEN = 1024
+
+
+def resolve_secret(config=None) -> str:
+    """Secret from the configuration, else the environment, else ''."""
+    if config is not None:
+        from ..core.config import SecurityOptions
+
+        s = config.get(SecurityOptions.CLUSTER_SECRET)
+        if s:
+            return s
+    return os.environ.get(ENV_VAR, "")
+
+
+def is_loopback(host: str) -> bool:
+    # NOTE: "" and "0.0.0.0"/"::" are INADDR_ANY — all interfaces, the
+    # OPPOSITE of loopback
+    return host in ("localhost", "127.0.0.1", "::1") or \
+        host.startswith("127.")
+
+
+def check_bind(host: str, secret: str, endpoint: str) -> None:
+    """Gate a pickle endpoint's bind: non-loopback without a secret is
+    refused outright; non-loopback WITH one still warns."""
+    if is_loopback(host):
+        return
+    if not secret:
+        raise RuntimeError(
+            f"{endpoint} deserializes pickle from the network and would "
+            f"bind non-loopback host {host!r} WITHOUT a cluster secret. "
+            f"Set {ENV_VAR} (or security.cluster-secret) on every process, "
+            "or bind loopback. Refusing to start an unauthenticated pickle "
+            "endpoint on a routable interface.")
+    warnings.warn(
+        f"{endpoint} binding non-loopback host {host!r}: connections are "
+        "authenticated with the cluster secret, but pickle endpoints "
+        "should additionally sit behind network-level access control",
+        RuntimeWarning, stacklevel=3)
+
+
+def token_ok(token: Optional[str], secret: str) -> bool:
+    """Constant-time acceptance check; with no secret configured every
+    caller is accepted (loopback-only deployments)."""
+    if not secret:
+        return True
+    return token is not None and hmac.compare_digest(
+        token.encode("utf-8"), secret.encode("utf-8"))
+
+
+def send_hello(sock: socket.socket, secret: str) -> None:
+    """Client side of the per-connection preamble (always sent, possibly
+    with an empty token, so the framing is version-stable)."""
+    tok = secret.encode("utf-8")
+    sock.sendall(_HELLO.pack(_MAGIC, len(tok)) + tok)
+
+
+def recv_hello(sock: socket.socket, secret: str) -> bool:
+    """Server side: read the preamble and decide acceptance BEFORE any
+    pickle frame is read. False means drop the connection."""
+    buf = b""
+    while len(buf) < _HELLO.size:
+        chunk = sock.recv(_HELLO.size - len(buf))
+        if not chunk:
+            return False
+        buf += chunk
+    magic, n = _HELLO.unpack(buf)
+    if magic != _MAGIC or n > _MAX_TOKEN:
+        return False
+    tok = b""
+    while len(tok) < n:
+        chunk = sock.recv(n - len(tok))
+        if not chunk:
+            return False
+        tok += chunk
+    if not secret:
+        return True
+    return hmac.compare_digest(tok, secret.encode("utf-8"))
