@@ -33,6 +33,7 @@ from ..window.assigners import (
 from ..window.triggers import CountTrigger, Evictor, PurgingTrigger, Trigger
 
 __all__ = ["DataStream", "KeyedStream", "WindowedStream", "ConnectedStreams",
+           "BroadcastStream", "BroadcastConnectedStream",
            "make_key_extractor"]
 
 KeySpec = Union[str, Callable[[Any], Any]]
@@ -177,9 +178,19 @@ class DataStream:
         from ..runtime.writer import RescalePartitioner
         return self._repartition("rescale", RescalePartitioner)
 
-    def broadcast(self) -> "DataStream":
+    def broadcast(self, *descriptors) -> "DataStream":
+        """Replicate every record to every downstream subtask. With
+        MapStateDescriptors the result is a BroadcastStream for the
+        broadcast state pattern: ``keyed.connect(rules.broadcast(desc))
+        .process(KeyedBroadcastProcessFunction)`` (reference
+        DataStream.broadcast(MapStateDescriptor...) ->
+        BroadcastConnectedStream.java:55)."""
         from ..runtime.writer import BroadcastPartitioner
-        return self._repartition("broadcast", BroadcastPartitioner)
+        replicated = self._repartition("broadcast", BroadcastPartitioner)
+        if descriptors:
+            return BroadcastStream(self.env, replicated.transformation,
+                                   descriptors)
+        return replicated
 
     def shuffle(self) -> "DataStream":
         from ..runtime.writer import ShufflePartitioner
@@ -209,6 +220,14 @@ class DataStream:
         return DataStream(self.env, t)
 
     def connect(self, other: "DataStream") -> "ConnectedStreams":
+        if isinstance(other, BroadcastStream):
+            raise NotImplementedError(
+                "broadcast state requires a KEYED stream: use "
+                "ds.key_by(...).connect(rules.broadcast(desc)) with a "
+                "KeyedBroadcastProcessFunction (the non-keyed "
+                "BroadcastProcessFunction variant is not implemented; "
+                "silently dropping the state descriptors would run the "
+                "job with no broadcast state at all)")
         return ConnectedStreams(self.env, self, other)
 
     def iterate(self, max_wait_s: float = 2.0) -> "IterativeStream":
@@ -336,12 +355,59 @@ class IterativeStream(DataStream):
         return feedback
 
 
+class BroadcastStream:
+    """A broadcast-partitioned stream bound to the MapStateDescriptors of
+    the broadcast state it will feed (reference BroadcastStream)."""
+
+    def __init__(self, env, transformation: Transformation, descriptors):
+        self.env = env
+        self.transformation = transformation
+        self.descriptors = list(descriptors)
+
+
+class BroadcastConnectedStream:
+    """Keyed stream + broadcast stream awaiting a
+    KeyedBroadcastProcessFunction (reference
+    BroadcastConnectedStream.java:55)."""
+
+    def __init__(self, env, keyed: "KeyedStream",
+                 broadcast: BroadcastStream):
+        self.env = env
+        self.keyed = keyed
+        self.broadcast = broadcast
+
+    def process(self, fn, name: str = "CoBroadcastWithKeyed",
+                out_schema: Optional[Schema] = None,
+                parallelism: Optional[int] = None) -> "DataStream":
+        from ..runtime.operators.co_broadcast import (
+            CoBroadcastWithKeyedOperator,
+        )
+
+        ke = self.keyed.key_extractor
+        descs = tuple(self.broadcast.descriptors)
+        t = TwoInputTransformation(
+            name=name,
+            operator_factory=lambda: CoBroadcastWithKeyedOperator(
+                fn, ke, descs, out_schema=out_schema, name=name),
+            parallelism=parallelism,
+            inputs=[self.keyed.transformation,
+                    self.broadcast.transformation],
+            key_extractor1=ke)
+        self.env._transformations.append(t)
+        return DataStream(self.env, t)
+
+
 class KeyedStream(DataStream):
     def __init__(self, env, transformation: Transformation, key_extractor,
                  key_spec: KeySpec):
         super().__init__(env, transformation)
         self.key_extractor = key_extractor
         self.key_spec = key_spec
+
+    def connect(self, other) -> "ConnectedStreams":
+        if isinstance(other, BroadcastStream):
+            return BroadcastConnectedStream(self.env, self, other)
+        return ConnectedStreams(self.env, self, other)
 
     def process(self, fn: ProcessFunction, name: str = "KeyedProcess",
                 parallelism: Optional[int] = None) -> "DataStream":

@@ -176,6 +176,99 @@ class ProcessFunction(Function, Generic[IN, OUT]):
 KeyedProcessFunction = ProcessFunction  # alias; keyed-ness comes from the stream
 
 
+class KeyedBroadcastProcessFunction(Function, Generic[IN, OUT]):
+    """Two-input function over a keyed stream + a broadcast stream
+    (reference KeyedBroadcastProcessFunction, applied by
+    BroadcastConnectedStream.process — CoBroadcastWithKeyedOperator.java:64).
+
+    ``process_element`` sees one keyed record with READ-ONLY access to the
+    broadcast state (every subtask holds an identical replica, and only
+    deterministic broadcast-side updates keep replicas identical);
+    ``process_broadcast_element`` sees one broadcast record on EVERY
+    subtask with read-write access. The canonical use is dynamic
+    rules/config distribution: rules ride the broadcast side into state,
+    the keyed side evaluates each event against them."""
+
+    class ReadOnlyContext:
+        def __init__(self, timestamp, current_key, broadcast_view,
+                     timer_service=None):
+            self.timestamp = timestamp
+            self.current_key = current_key
+            self.timer_service = timer_service
+            self._view = broadcast_view
+
+        def get_broadcast_state(self, descriptor) -> "_ReadOnlyMap":
+            return self._view(descriptor.name)
+
+    class Context:
+        def __init__(self, timestamp, broadcast_rw, apply_keyed=None):
+            self.timestamp = timestamp
+            self._rw = broadcast_rw
+            self._apply_keyed = apply_keyed
+
+        def get_broadcast_state(self, descriptor) -> dict:
+            return self._rw(descriptor.name)
+
+        def apply_to_keyed_state(self, descriptor, fn) -> None:
+            """Run ``fn(key, state)`` for every key holding state under
+            ``descriptor`` on this subtask (reference
+            Context.applyToKeyedState) — the broadcast side's only window
+            into keyed state, e.g. to replay events buffered before a
+            rule arrived."""
+            if self._apply_keyed is None:
+                raise RuntimeError("keyed state access not wired")
+            self._apply_keyed(descriptor, fn)
+
+    def process_element(self, value: IN,
+                        ctx: "KeyedBroadcastProcessFunction.ReadOnlyContext",
+                        out: Collector[OUT]) -> None:
+        raise NotImplementedError
+
+    def process_broadcast_element(
+            self, value: IN, ctx: "KeyedBroadcastProcessFunction.Context",
+            out: Collector[OUT]) -> None:
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int,
+                 ctx: "KeyedBroadcastProcessFunction.ReadOnlyContext",
+                 out: Collector[OUT]) -> None:
+        pass
+
+
+class _ReadOnlyMap:
+    """Read-only view of a broadcast state map (keyed side must not write:
+    per-subtask writes would diverge the replicas)."""
+
+    __slots__ = ("_m",)
+
+    def __init__(self, m: dict):
+        self._m = m
+
+    def get(self, k, default=None):
+        return self._m.get(k, default)
+
+    def __getitem__(self, k):
+        return self._m[k]
+
+    def __contains__(self, k):
+        return k in self._m
+
+    def __iter__(self):
+        return iter(self._m)
+
+    def __len__(self):
+        return len(self._m)
+
+    def items(self):
+        return self._m.items()
+
+    def keys(self):
+        return self._m.keys()
+
+    def values(self):
+        return self._m.values()
+
+
 class SourceFunction(Function, Generic[OUT]):
     """Legacy-style run/cancel source; prefer connectors (FLIP-27 analog)."""
 

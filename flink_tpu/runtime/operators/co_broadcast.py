@@ -1,0 +1,174 @@
+"""Connected broadcast stream operator: keyed input + replicated state.
+
+Analog of the reference's CoBroadcastWithKeyedOperator
+(flink-streaming-java .../api/operators/co/CoBroadcastWithKeyedOperator
+.java:64) behind BroadcastConnectedStream.process
+(.../api/datastream/BroadcastConnectedStream.java:55): input 1 is the
+keyed event stream, input 2 is broadcast — every subtask receives every
+broadcast record and applies it to its own replica of the broadcast
+(map) state, so replicas stay identical as long as the user function's
+broadcast-side updates are deterministic (the same contract the
+reference documents).
+
+Checkpointing: the broadcast maps ride the OPERATOR (non-keyed) snapshot
+under ``"broadcast"``. Every subtask snapshots an identical copy; on a
+same-parallelism restore each subtask takes its own copy back, and on
+rescale OperatorStateBackend.redistribute hands every new subtask the
+first copy (identical by construction) — the reference redistributes
+broadcast state the same way. The keyed side uses the ordinary keyed
+backend + timers of KeyedProcessOperator.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...core.functions import (
+    Collector, KeyedBroadcastProcessFunction, _ReadOnlyMap, copy_per_subtask,
+)
+from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
+from ...runtime.timers import InternalTimerService
+from .base import OperatorContext, Output, TwoInputOperator
+
+__all__ = ["CoBroadcastWithKeyedOperator"]
+
+
+class CoBroadcastWithKeyedOperator(TwoInputOperator):
+    def __init__(self, fn: KeyedBroadcastProcessFunction, key_extractor,
+                 descriptors, out_schema: Optional[Schema] = None,
+                 name: str = "CoBroadcastWithKeyed"):
+        super().__init__(name)
+        self._fn = copy_per_subtask(fn)
+        self._key_extractor = key_extractor
+        self._descriptors = list(descriptors)
+        self._maps: dict[str, dict] = {d.name: {} for d in self._descriptors}
+        self._out_schema = out_schema
+        self._backend = None
+        self._timers: Optional[InternalTimerService] = None
+        self._pending_rows: list = []
+        self._pending_ts: list[int] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def setup(self, ctx: OperatorContext, output: Output) -> None:
+        super().setup(ctx, output)
+        self._backend = ctx.create_keyed_backend()
+        self._timers = InternalTimerService(
+            ctx.key_group_range, ctx.max_parallelism,
+            on_event_time=self._fire_timer, on_processing_time=None)
+
+    def initialize_state(self, keyed_snapshots: list,
+                         operator_snapshot) -> None:
+        if keyed_snapshots:
+            self._backend.restore([s["backend"] for s in keyed_snapshots])
+            self._timers.restore([s["timers"] for s in keyed_snapshots])
+        if operator_snapshot:
+            restored = operator_snapshot.get("broadcast") or {}
+            for name, m in restored.items():
+                self._maps[name] = dict(m)
+
+    def open(self) -> None:
+        from .simple import _runtime_context
+
+        self._fn.open(_runtime_context(self, self._backend))
+
+    def close(self) -> None:
+        self._fn.close()
+
+    # -- broadcast state access -------------------------------------------
+    def _view(self, name: str) -> _ReadOnlyMap:
+        return _ReadOnlyMap(self._maps[name])
+
+    def _rw(self, name: str) -> dict:
+        return self._maps[name]
+
+    # -- output ------------------------------------------------------------
+    def _collector(self) -> Collector:
+        def sink(value, timestamp):
+            self._pending_rows.append(value)
+            self._pending_ts.append(
+                MIN_TIMESTAMP if timestamp is None else int(timestamp))
+        return Collector(sink)
+
+    def _flush_pending(self) -> None:
+        if not self._pending_rows:
+            return
+        out, self._out_schema = RecordBatch.from_rows_infer(
+            self._out_schema, self._pending_rows, self._pending_ts)
+        self.output.emit(out)
+        self._pending_rows, self._pending_ts = [], []
+
+    # -- data path ---------------------------------------------------------
+    def process_batch1(self, batch: RecordBatch) -> None:
+        keys = self._key_extractor(batch)
+        out = self._collector()
+        for i in range(batch.n):
+            key = keys[i]
+            key = key.item() if isinstance(key, np.generic) else key
+            self._backend.set_current_key(key)
+            ts = int(batch.timestamps[i])
+            ctx = KeyedBroadcastProcessFunction.ReadOnlyContext(
+                None if ts == MIN_TIMESTAMP else ts, key, self._view,
+                timer_service=self._timer_api(key))
+            self._fn.process_element(batch.row(i), ctx, out)
+        self._flush_pending()
+
+    def process_batch2(self, batch: RecordBatch) -> None:
+        out = self._collector()
+        for i in range(batch.n):
+            ts = int(batch.timestamps[i])
+            ctx = KeyedBroadcastProcessFunction.Context(
+                None if ts == MIN_TIMESTAMP else ts, self._rw,
+                apply_keyed=self._apply_to_keyed_state)
+            self._fn.process_broadcast_element(batch.row(i), ctx, out)
+        self._flush_pending()
+
+    def _apply_to_keyed_state(self, descriptor, fn) -> None:
+        for key in list(self._backend.keys(descriptor.name)):
+            self._backend.set_current_key(key)
+            fn(key, self._backend.get_partitioned_state(descriptor))
+
+    # -- timers ------------------------------------------------------------
+    def _timer_api(self, key):
+        op = self
+
+        class _TimerApi:
+            current_watermark = property(
+                lambda s: op._timers.current_watermark)
+
+            def register_event_time_timer(self, ts, namespace=None):
+                op._timers.register_event_time_timer(key, ts, namespace)
+
+            def delete_event_time_timer(self, ts, namespace=None):
+                op._timers.delete_event_time_timer(key, ts, namespace)
+
+        return _TimerApi()
+
+    def _fire_timer(self, key, ts, namespace) -> None:
+        self._backend.set_current_key(key)
+        out = self._collector()
+        ctx = KeyedBroadcastProcessFunction.ReadOnlyContext(
+            ts, key, self._view, timer_service=self._timer_api(key))
+        self._fn.on_timer(ts, ctx, out)
+        self._flush_pending()
+
+    def process_watermark_n(self, input_index: int, watermark) -> None:
+        # fire timers and flush their output BEFORE the base class
+        # forwards the watermark (KeyedProcessOperator's ordering): rows
+        # produced by on_timer carry ts <= wm and would otherwise arrive
+        # behind the watermark that triggered them — late by construction
+        wms = list(self._input_watermarks)
+        wms[input_index] = watermark.timestamp
+        self._timers.advance_watermark(min(wms))
+        self._flush_pending()
+        super().process_watermark_n(input_index, watermark)
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        return {
+            "keyed": {"backend": self._backend.snapshot(checkpoint_id),
+                      "timers": self._timers.snapshot()},
+            "operator": {"broadcast": {n: dict(m)
+                                       for n, m in self._maps.items()}},
+        }

@@ -18,10 +18,13 @@ scatter-fold path for integer keys.
 State is laid out per key group (``_state[kg][key] -> float64[n_slots]``)
 so snapshots re-shard on rescale exactly like the heap backend.
 
-Retraction limits match the reference's non-DataView aggregates: SUM/COUNT
-retract exactly; MIN/MAX are correct for append-only input and degrade to
-"last aggregate stands" under retraction (the reference needs a sorted
-MapView for retractable MIN/MAX; out of scope here, documented).
+Retraction: SUM/COUNT/AVG retract exactly (additive). MIN/MAX are exact
+too when constructed with ``retract_minmax=True`` (a value->multiplicity
+map per key per aggregate, the reference's
+MinWithRetractAggFunction.java:36 MapView accumulator) — the planner
+enables it whenever the input is a changelog; append-only input keeps
+the cheap scalar fold (reference planner picks the no-retract variants
+the same way).
 """
 
 from __future__ import annotations
@@ -63,11 +66,27 @@ class GroupAggOperator(OneInputOperator):
     def __init__(self, key_columns: Sequence[str], aggs: Sequence[SqlAggSpec],
                  count_star_index: Optional[int] = None,
                  partial_input: bool = False,
+                 retract_minmax: bool = False,
                  name: str = "GroupAgg"):
+        """``retract_minmax``: maintain a per-key value->multiplicity map
+        for every MIN/MAX aggregate so retractions are EXACT (reference
+        MinWithRetractAggFunction.java:36's MapView accumulator). The
+        planner enables it when the input is a changelog; append-only
+        input keeps the cheap scalar fold."""
         super().__init__(name)
         self._key_columns = list(key_columns)
         self._aggs = list(aggs)
         self._partial_input = bool(partial_input)
+        self._retract_minmax = bool(retract_minmax)
+        self._mm_idx = [i for i, a in enumerate(aggs)
+                        if a.kind in ("min", "max")]
+        if self._partial_input and self._retract_minmax and self._mm_idx:
+            raise ValueError(
+                "retractable MIN/MAX cannot consume pre-reduced partials "
+                "(the local combine folds extrema lossily); the planner "
+                "disables the two-phase split in this case")
+        # kg -> key -> [value->count dict per min/max agg]
+        self._mm_counts: dict[int, dict[Any, list]] = {}
         for a in self._aggs:
             if a.distinct:
                 raise NotImplementedError(
@@ -134,11 +153,31 @@ class GroupAggOperator(OneInputOperator):
                 partials[:, off] = np.add.reduceat(col * s, starts)
                 if a.kind == "avg":
                     partials[:, off + 1] = np.add.reduceat(s, starts)
-            else:  # min/max: append-only semantics
+            else:  # min/max
                 col = batch.column(a.field)[order].astype(np.float64)
+                if self._retract_minmax:
+                    # exact under retraction: ship the per-group raw
+                    # (value, delta) runs to the count-map merge instead
+                    # of a lossy extremum fold
+                    continue
                 red = np.minimum if a.kind == "min" else np.maximum
                 partials[:, off] = red.reduceat(col, starts)
-        return uniq, key_rows, partials
+        extras = None
+        if self._retract_minmax and self._mm_idx:
+            extras = []
+            ends = np.append(starts[1:], len(keys))
+            cols_sorted = {a.field: batch.column(a.field)[order]
+                           .astype(np.float64)
+                           for i, a in enumerate(self._aggs)
+                           if i in self._mm_idx}
+            s_sorted = s
+            for gi in range(len(uniq)):
+                lo, hi = int(starts[gi]), int(ends[gi])
+                extras.append([
+                    (cols_sorted[self._aggs[i].field][lo:hi],
+                     s_sorted[lo:hi])
+                    for i in self._mm_idx])
+        return uniq, key_rows, partials, extras
 
     def _combine_partials(self, batch: RecordBatch
                           ) -> tuple[np.ndarray, list, np.ndarray]:
@@ -170,8 +209,9 @@ class GroupAggOperator(OneInputOperator):
             return
         if self._partial_input:
             uniq, key_rows, partials = self._combine_partials(batch)
+            extras = None
         else:
-            uniq, key_rows, partials = self._local_partials(batch)
+            uniq, key_rows, partials, extras = self._local_partials(batch)
 
         # global phase: one state merge per distinct key + changelog emit
         out_rows: list[tuple] = []
@@ -192,11 +232,14 @@ class GroupAggOperator(OneInputOperator):
             if first:
                 acc = self._new_acc()
             self._merge(acc, partials[gi])
+            if extras is not None:
+                self._merge_minmax_counts(kg, key, acc, extras[gi])
             if acc[0] <= 0:
                 # group fully retracted: DELETE carries the pre-merge row
                 # (reference GroupAggFunction emits -D of the old aggregate)
                 if not first:
                     kg_map.pop(key, None)
+                    self._mm_counts.get(kg, {}).pop(key, None)
                     out_rows.append(prev_row[:-1] + (int(rk.DELETE),))
                     out_ts.append(ts_max)
                 continue
@@ -218,10 +261,43 @@ class GroupAggOperator(OneInputOperator):
             elif a.kind == "avg":
                 acc[off] += partial[off]
                 acc[off + 1] += partial[off + 1]
+            elif self._retract_minmax:
+                pass  # extrema maintained by _merge_minmax_counts
             elif a.kind == "min":
                 acc[off] = min(acc[off], partial[off])
             else:
                 acc[off] = max(acc[off], partial[off])
+
+    def _merge_minmax_counts(self, kg: int, key: Any, acc: np.ndarray,
+                             group_extras: list) -> None:
+        """Exact MIN/MAX under retraction: per-agg value->multiplicity
+        maps (reference MinWithRetractAggFunction.java:36). The extremum
+        recomputes over the key's live-value map once per touched group
+        per batch — O(distinct live values), the same order the reference
+        pays iterating its MapView when the extremum retracts."""
+        maps = self._mm_counts.setdefault(kg, {}).setdefault(
+            key, [dict() for _ in self._mm_idx])
+        for slot, (vals, signs) in zip(range(len(self._mm_idx)),
+                                       group_extras):
+            agg_i = self._mm_idx[slot]
+            a = self._aggs[agg_i]
+            off = self._offsets[agg_i]
+            m = maps[slot]
+            for v, sgn in zip(vals.tolist(), signs.tolist()):
+                if sgn > 0:
+                    m[v] = m.get(v, 0) + 1
+                else:
+                    c = m.get(v, 0) - 1
+                    if c > 0:
+                        m[v] = c
+                    else:
+                        m.pop(v, None)
+            if not m:
+                acc[off] = _INITS[a.kind]
+            elif a.kind == "min":
+                acc[off] = min(m)
+            else:
+                acc[off] = max(m)
 
     def _emit_row(self, key_row: tuple, acc: np.ndarray, kind) -> tuple:
         return key_row + tuple(self._results_from_acc(acc)) + (int(kind),)
@@ -256,8 +332,14 @@ class GroupAggOperator(OneInputOperator):
 
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:
-        return {"keyed": {"backend": {
-            "group-agg": {kg: dict(m) for kg, m in self._state.items()}}}}
+        snap = {"group-agg": {kg: dict(m)
+                              for kg, m in self._state.items()}}
+        if self._mm_counts:
+            snap["group-agg-mm"] = {
+                kg: {k: [dict(m) for m in maps]
+                     for k, maps in keys.items()}
+                for kg, keys in self._mm_counts.items()}
+        return {"keyed": {"backend": snap}}
 
     def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
         for snap in keyed_snapshots:
@@ -265,6 +347,11 @@ class GroupAggOperator(OneInputOperator):
             for kg, entries in table.items():
                 if kg in self.ctx.key_group_range:
                     self._state.setdefault(kg, {}).update(entries)
+            for kg, keys in snap["backend"].get("group-agg-mm", {}).items():
+                if kg in self.ctx.key_group_range:
+                    tgt = self._mm_counts.setdefault(kg, {})
+                    for k, maps in keys.items():
+                        tgt[k] = [dict(m) for m in maps]
 
 
 
@@ -312,7 +399,8 @@ class LocalGroupAggOperator(OneInputOperator):
         if batch.n == 0:
             return
         schema = self._schema_for(batch.schema)
-        _uniq, key_rows, partials = self._core._local_partials(batch)
+        _uniq, key_rows, partials, _extras = \
+            self._core._local_partials(batch)
         g = len(key_rows)
         cols: dict[str, np.ndarray] = {}
         for i, n in enumerate(self._key_columns):

@@ -32,7 +32,7 @@ from ..runtime.operators.base import (
 from . import rowkind as rk
 
 __all__ = ["StreamingJoinOperator", "IntervalJoinOperator",
-           "LookupJoinOperator"]
+           "LookupJoinOperator", "TemporalJoinOperator"]
 
 
 
@@ -418,6 +418,191 @@ class IntervalJoinOperator(TwoInputOperator):
                     self._restored_device.pop(side),
                     rows_per_key=self.rows_per_key)
             self._device = True
+
+
+class TemporalJoinOperator(TwoInputOperator):
+    """Event-time temporal (versioned-table) join: each left (append)
+    row joins the right-side VERSION that was valid at the left row's
+    event time (reference StreamExecTemporalJoin.java:77 /
+    TemporalRowTimeJoinOperator).
+
+    Input 2 is a changelog/upsert stream building the versioned table:
+    INSERT/UPDATE_AFTER rows start a new version at their timestamp,
+    DELETE rows a tombstone (no valid version from then on);
+    UPDATE_BEFORE rows are ignored (the matching UA carries the state).
+    Left rows buffer until the combined watermark passes their timestamp —
+    only then are all versions <= t known — and emit as INSERT rows
+    (inner drops versionless rows, left pads nulls). Version history at
+    or below the watermark compacts to the latest entry per key."""
+
+    def __init__(self, join_type: str, key_index1: int, key_index2: int,
+                 out_schema: Schema, n_left: int, n_right: int,
+                 name: str = "TemporalJoin"):
+        super().__init__(name)
+        if join_type not in ("inner", "left"):
+            raise ValueError("temporal join supports inner|left")
+        self.join_type = join_type
+        self.key_idx = (key_index1, key_index2)
+        self.out_schema = out_schema
+        self.n_fields = (n_left, n_right)
+        self._null_right = tuple([None] * n_right)
+        # kg -> key -> [ts_list, row_list] parallel sorted arrays
+        # (row None = tombstone); parallel lists keep the bisect O(log V)
+        # per probe instead of rebuilding a timestamp list per record
+        self._versions: dict[int, dict[Any, list]] = {}
+        # kg -> [(ts, key, row)] awaiting the watermark
+        self._left_buf: dict[int, list] = {}
+        # version-table keys touched since the last compaction: the
+        # watermark pass prunes only these (untouched keys prune when
+        # next touched)
+        self._dirty_keys: set = set()
+
+    # -- ingest ------------------------------------------------------------
+    def process_batch1(self, batch: RecordBatch) -> None:
+        names = [f.name for f in batch.schema.fields
+                 if f.name != rk.ROWKIND_COLUMN]
+        cols = [batch.column(n) for n in names]
+        kinds = (np.asarray(batch.column(rk.ROWKIND_COLUMN))
+                 if rk.ROWKIND_COLUMN in batch.schema else None)
+        ts_arr = batch.timestamps
+        for i in range(batch.n):
+            if kinds is not None and kinds[i] != rk.INSERT:
+                raise ValueError(
+                    "temporal join: the probe side must be append-only "
+                    "(reference: updating left inputs need a changelog "
+                    "temporal join, not supported)")
+            row = tuple(_scalar(c[i]) for c in cols)
+            key = _key_of(row, self.key_idx[0])
+            kg = assign_to_key_group(key, self.ctx.max_parallelism)
+            self._left_buf.setdefault(kg, []).append(
+                (int(ts_arr[i]), key, row))
+
+    def process_batch2(self, batch: RecordBatch) -> None:
+        names = [f.name for f in batch.schema.fields
+                 if f.name != rk.ROWKIND_COLUMN]
+        cols = [batch.column(n) for n in names]
+        kinds = (np.asarray(batch.column(rk.ROWKIND_COLUMN))
+                 if rk.ROWKIND_COLUMN in batch.schema else None)
+        ts_arr = batch.timestamps
+        import bisect
+        for i in range(batch.n):
+            kind = int(kinds[i]) if kinds is not None else rk.INSERT
+            if kind == rk.UPDATE_BEFORE:
+                continue
+            key_row = tuple(_scalar(c[i]) for c in cols)
+            row = None if kind == rk.DELETE else key_row
+            key = _key_of(key_row, self.key_idx[1])
+            kg = assign_to_key_group(key, self.ctx.max_parallelism)
+            entry = self._versions.setdefault(kg, {}).setdefault(
+                key, [[], []])
+            ts_list, row_list = entry
+            ts = int(ts_arr[i])
+            # keep sorted by version time; equal timestamps: last wins
+            pos = bisect.bisect_right(ts_list, ts)
+            if pos > 0 and ts_list[pos - 1] == ts:
+                row_list[pos - 1] = row
+            else:
+                ts_list.insert(pos, ts)
+                row_list.insert(pos, row)
+            self._dirty_keys.add((kg, key))
+
+    # -- emission ----------------------------------------------------------
+    def process_watermark_n(self, input_index: int, watermark) -> None:
+        # release buffered rows BEFORE the base class forwards the
+        # watermark: a downstream event-time operator must see the rows
+        # (all with ts <= wm) ahead of the watermark that closed them, or
+        # every temporal-join result would arrive late by construction
+        wms = list(self._input_watermarks)
+        wms[input_index] = watermark.timestamp
+        wm = min(wms)
+        import bisect
+        out_rows, out_ts = [], []
+        for kg, buf in list(self._left_buf.items()):
+            keep = []
+            for ts, key, row in buf:
+                if ts > wm:
+                    keep.append((ts, key, row))
+                    continue
+                entry = self._versions.get(kg, {}).get(key)
+                vrow = None
+                if entry is not None:
+                    pos = bisect.bisect_right(entry[0], ts)
+                    vrow = entry[1][pos - 1] if pos > 0 else None
+                if vrow is not None:
+                    out_rows.append(row + vrow + (rk.INSERT,))
+                    out_ts.append(ts)
+                elif self.join_type == "left":
+                    out_rows.append(row + self._null_right + (rk.INSERT,))
+                    out_ts.append(ts)
+            if keep:
+                self._left_buf[kg] = keep
+            else:
+                del self._left_buf[kg]
+        # compact TOUCHED keys' version history: keep the newest version
+        # at/below the watermark (rows between it and wm still need it)
+        # plus everything above. A key stays dirty while it still holds
+        # multiple versions (one lagging input can leave wm at -inf, which
+        # compacts nothing — dirtiness must survive that watermark).
+        still_dirty = set()
+        for kg, key in self._dirty_keys:
+            keys = self._versions.get(kg, {})
+            entry = keys.get(key)
+            if entry is None:
+                continue
+            ts_list, row_list = entry
+            pos = bisect.bisect_right(ts_list, wm)
+            if pos > 1:
+                entry[0] = ts_list = ts_list[pos - 1:]
+                entry[1] = row_list = row_list[pos - 1:]
+            if (len(ts_list) == 1 and row_list[0] is None
+                    and ts_list[0] <= wm):
+                del keys[key]   # settled tombstone: key is gone
+            elif len(ts_list) > 1 or row_list[-1] is None:
+                # still compactable later: multiple versions, or a
+                # tombstone the watermark has not settled yet (dropping
+                # it here would leak the entry forever)
+                still_dirty.add((kg, key))
+        self._dirty_keys = still_dirty
+        if out_rows:
+            self.output.emit(RecordBatch.from_rows(
+                self.out_schema, out_rows, out_ts))
+        super().process_watermark_n(input_index, watermark)
+
+    # -- checkpointing -----------------------------------------------------
+    def snapshot_state(self, checkpoint_id: int) -> dict:
+        return {"keyed": {"backend": {
+            "temporal-versions": {
+                kg: {k: [list(e[0]), list(e[1])]
+                     for k, e in keys.items()}
+                for kg, keys in self._versions.items()},
+            "temporal-left": {kg: list(v)
+                              for kg, v in self._left_buf.items()}}}}
+
+    def initialize_state(self, keyed_snapshots: list,
+                         operator_snapshot) -> None:
+        for snap in keyed_snapshots:
+            table = snap.get("backend", {})
+            for kg, keys in table.get("temporal-versions", {}).items():
+                if kg in self.ctx.key_group_range:
+                    tgt = self._versions.setdefault(kg, {})
+                    for k, (ts_list, row_list) in keys.items():
+                        entry = tgt.setdefault(k, [[], []])
+                        pairs = sorted(
+                            list(zip(entry[0], entry[1]))
+                            + [(int(t), tuple(r) if r is not None else None)
+                               for t, r in zip(ts_list, row_list)],
+                            key=lambda v: v[0])
+                        entry[0] = [p[0] for p in pairs]
+                        entry[1] = [p[1] for p in pairs]
+            for kg, buf in table.get("temporal-left", {}).items():
+                if kg in self.ctx.key_group_range:
+                    self._left_buf.setdefault(kg, []).extend(
+                        (int(t), k, tuple(r)) for t, k, r in buf)
+        # restored version histories must be compactable without waiting
+        # for the key to be touched again
+        for kg, keys in self._versions.items():
+            for key in keys:
+                self._dirty_keys.add((kg, key))
 
 
 class LookupJoinOperator(OneInputOperator):

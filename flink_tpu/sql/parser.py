@@ -57,12 +57,16 @@ class TableRef:
 @dataclass
 class JoinClause:
     """FROM a JOIN b ON cond (reference SqlJoin). ``kind`` in
-    INNER|LEFT|RIGHT|FULL; multi-way joins left-nest."""
+    INNER|LEFT|RIGHT|FULL; multi-way joins left-nest.
+    ``temporal_time`` set => ``b FOR SYSTEM_TIME AS OF <expr>``: b is a
+    versioned table and the join picks the version valid at the left
+    row's time (reference SqlSnapshot -> StreamExecTemporalJoin)."""
 
     kind: str
     left: "FromClause"
     right: "FromClause"
     on: Expr
+    temporal_time: Optional[Expr] = None
 
 
 @dataclass
@@ -253,9 +257,24 @@ class _Parser:
                 return left
             self.expect_kw("JOIN")
             right = self.from_primary()
+            temporal_time = None
+            if self.eat_kw("FOR"):
+                # b FOR SYSTEM_TIME AS OF l.rowtime [AS alias]
+                self.expect_kw("SYSTEM_TIME")
+                self.expect_kw("AS")
+                self.expect_kw("OF")
+                temporal_time = self.expr()
+                alias = self.maybe_alias()
+                if alias is not None:
+                    if isinstance(right, TableRef):
+                        right.alias = alias
+                    else:
+                        raise SqlError(
+                            "FOR SYSTEM_TIME alias requires a plain table")
             self.expect_kw("ON")
             cond = self.expr()
-            left = JoinClause(kind, left, right, cond)
+            left = JoinClause(kind, left, right, cond,
+                              temporal_time=temporal_time)
 
     def from_primary(self) -> FromClause:
         if self.eat_op("("):
@@ -298,7 +317,7 @@ class _Parser:
         if (self.peek()[0] == "id"
                 and not self.at_kw("WHERE", "GROUP", "HAVING", "ORDER",
                                    "LIMIT", "ON", "JOIN", "INNER", "LEFT",
-                                   "RIGHT", "FULL", "OUTER")):
+                                   "RIGHT", "FULL", "OUTER", "FOR")):
             return self.next()[1]
         return None
 

@@ -39,7 +39,7 @@ from .expressions import (
     collect_columns, compile_expr, rewrite_expr,
 )
 from .group_agg import GroupAggOperator, SqlAggSpec
-from .join import StreamingJoinOperator
+from .join import StreamingJoinOperator, TemporalJoinOperator
 from .parser import JoinClause, SelectItem, SelectStmt, TableRef, WindowTVF
 from .topn import TopNOperator
 
@@ -207,10 +207,35 @@ class _Planner:
 
         n_l, n_r = len(lnames), len(rnames)
         jt = join_type
-        joined = lkeyed.connect(rkeyed).transform(
-            "Join",
-            lambda: StreamingJoinOperator(jt, lkey_idx, rkey_idx,
-                                          out_schema, n_l, n_r))
+        if jc.temporal_time is not None:
+            # b FOR SYSTEM_TIME AS OF l.rowtime: versioned-table join
+            # (reference StreamExecTemporalJoin.java:77). Event time rides
+            # the record timestamps; the AS OF column must name the left
+            # side's time attribute (documenting which side is probed).
+            if join_type not in ("inner", "left"):
+                raise PlanError(
+                    "temporal join supports INNER and LEFT JOIN only")
+            tcol = jc.temporal_time
+            if not isinstance(tcol, Column):
+                raise PlanError("FOR SYSTEM_TIME AS OF expects a column")
+            # the time attribute is the stream's out-of-band record
+            # timestamp, so the AS OF column need not be a data column —
+            # but its qualifier must name the LEFT (probe) side
+            on_left = (tcol.table in lq if tcol.table is not None
+                       else tcol.name in out_l)
+            if not on_left:
+                raise PlanError(
+                    "FOR SYSTEM_TIME AS OF must reference the left "
+                    "(probe) side's time attribute")
+            joined = lkeyed.connect(rkeyed).transform(
+                "TemporalJoin",
+                lambda: TemporalJoinOperator(jt, lkey_idx, rkey_idx,
+                                             out_schema, n_l, n_r))
+        else:
+            joined = lkeyed.connect(rkeyed).transform(
+                "Join",
+                lambda: StreamingJoinOperator(jt, lkey_idx, rkey_idx,
+                                              out_schema, n_l, n_r))
         if residual:
             cond = residual[0]
             for c in residual[1:]:
@@ -479,9 +504,17 @@ class _Planner:
             return (f.dtype is not object
                     and np.issubdtype(np.dtype(f.dtype), np.integer))
 
+        # changelog input + MIN/MAX => the retract-exact count-map path
+        # (host only, single phase): the local combine and the device fold
+        # both reduce extrema lossily (MinWithRetractAggFunction analog)
+        retract_mm = (rk.ROWKIND_COLUMN in pre_schema
+                      and any(s.kind in ("min", "max") for s in specs))
+        if retract_mm:
+            two_phase = False
         use_device = (self.env.config.get(StateOptions.BACKEND) == "tpu"
                       and all(_int_key(n) for n in key_names)
-                      and all(not s.distinct for s in specs))
+                      and all(not s.distinct for s in specs)
+                      and not retract_mm)
         if two_phase and not use_device:
             from .group_agg import LocalGroupAggOperator
             ds = ds.transform(
@@ -524,7 +557,8 @@ class _Planner:
             out = keyed._one_input(
                 "GroupAggregate",
                 lambda: GroupAggOperator(
-                    names, specs, partial_input=two_phase),
+                    names, specs, partial_input=two_phase,
+                    retract_minmax=retract_mm),
                 key_extractor=keyed.key_extractor)
         out_schema = Schema(
             [(n, np.float64 if n.startswith("__key") else object)

@@ -180,7 +180,10 @@ class OperatorStateBackend:
     @staticmethod
     def redistribute(snapshots: list[dict], new_parallelism: int) -> list[dict]:
         """split: round-robin elements across new subtasks;
-        union: every subtask gets everything."""
+        union: every subtask gets everything;
+        broadcast maps (CoBroadcastWithKeyedOperator): every old subtask
+        snapshotted an IDENTICAL replica, so each new subtask receives
+        the first copy (reference: broadcast state re-shipped whole)."""
         names = set()
         modes: dict[str, str] = {}
         for s in snapshots:
@@ -196,6 +199,11 @@ class OperatorStateBackend:
             else:
                 for i, item in enumerate(all_items):
                     out[i % new_parallelism]["lists"][name].append(item)
+        bmap = next((s["broadcast"] for s in snapshots
+                     if s.get("broadcast")), None)
+        if bmap is not None:
+            for o in out:
+                o["broadcast"] = {n: dict(m) for n, m in bmap.items()}
         return out
 
     def restore(self, snapshot: dict) -> None:
