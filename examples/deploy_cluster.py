@@ -1,0 +1,39 @@
+"""Session-cluster deployment: start a Dispatcher, submit a pipeline
+remotely through ClusterClient, poll to completion (the reference's
+flink run against a standing cluster)."""
+import numpy as np
+
+from flink_tpu.api import StreamExecutionEnvironment
+from flink_tpu.cluster.dispatcher import ClusterClient, Dispatcher
+from flink_tpu.core.records import Schema
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+def main():
+    d = Dispatcher()
+    port = d.start()
+    try:
+        env = StreamExecutionEnvironment()
+        rows = [(i % 5, i) for i in range(100)]
+        from flink_tpu.core.functions import SinkFunction
+
+        class _Discard(SinkFunction):
+            def invoke_batch(self, batch):
+                return True
+
+        counted = (env.from_collection(rows, SCHEMA,
+                                       timestamps=list(range(100)))
+                   .key_by("k").sum(1))
+        counted.add_sink(_Discard(), "discard")
+        client = ClusterClient(f"127.0.0.1:{port}", config=env.config)
+        job_id = client.submit(env, name="example-job")
+        final = client.wait(job_id, timeout=120.0)
+        print(f"job {job_id}: {final['state']}")
+        return final
+    finally:
+        d.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,7 @@ class StreamExecutionEnvironment:
         and this blocks until the remote job is terminal."""
         if self._remote_target:
             from ..cluster.dispatcher import ClusterClient
-            client = ClusterClient(self._remote_target)
+            client = ClusterClient(self._remote_target, config=self.config)
             # a pending savepoint restore ships with the submission — the
             # remote supervisor starts the job from it, matching the local
             # path's semantics

@@ -398,19 +398,28 @@ def register_connector(name: str, source=None, sink=None) -> None:
     _PLUGIN_CONNECTORS[name] = {"source": source, "sink": sink}
 
 
-def _broker(name: str):
+def _broker(name: str, config=None):
     """Named in-process broker, or a TCP client when the option looks like
-    host:port (the real-cluster path: a LogBrokerServer listens there)."""
+    host:port (the real-cluster path: a LogBrokerServer listens there).
+    ``config`` feeds the cluster-secret resolution of the TCP client; the
+    cache key includes the resolved secret so a later caller with a
+    DIFFERENT secret gets its own connection instead of silently reusing
+    one authenticated (or not) as someone else."""
+    from ..utils import auth
+
+    cache_key = name
+    if ":" in name:
+        cache_key = (name, auth.resolve_secret(config))
     with _BROKERS_LOCK:
-        b = _BROKERS.get(name)
+        b = _BROKERS.get(cache_key)
         if b is None:
             if ":" in name:     # cached per address: one connection, not
                 from ..connectors.log_net import RemoteLogBroker  # per stmt
-                b = RemoteLogBroker(name)
+                b = RemoteLogBroker(name, config=config)
             else:
                 from ..connectors.log import InMemoryLogBroker
                 b = InMemoryLogBroker()
-            _BROKERS[name] = b
+            _BROKERS[cache_key] = b
         return b
 
 
@@ -499,7 +508,8 @@ def instantiate_source(env, entry: CatalogTable):
         if getattr(fmt, "binary", False):
             raise SqlError("log topics carry text lines; use csv|json "
                            f"(table {entry.name!r})")
-        src = LogSource(_broker(opts.get("broker", "default")),
+        src = LogSource(_broker(opts.get("broker", "default"),
+                        config=env.config),
                         opts["topic"], fmt,
                         bounded=opts.get("bounded", "false") == "true",
                         starting_offsets=opts.get("scan.startup.mode",
@@ -521,9 +531,10 @@ def instantiate_source(env, entry: CatalogTable):
                    f"{entry.name!r}")
 
 
-def instantiate_sink(entry: CatalogTable):
+def instantiate_sink(entry: CatalogTable, config=None):
     """Build a Sink (or SinkFunction) for INSERT INTO's target
-    (reference FactoryUtil.createDynamicTableSink)."""
+    (reference FactoryUtil.createDynamicTableSink). ``config`` feeds the
+    cluster-secret resolution of network-backed connectors."""
     opts = entry.options
     connector = opts.get("connector")
     if connector == "filesystem":
@@ -535,7 +546,7 @@ def instantiate_sink(entry: CatalogTable):
         if getattr(fmt, "binary", False):
             raise SqlError("log topics carry text lines; use csv|json "
                            f"(table {entry.name!r})")
-        broker = _broker(opts.get("broker", "default"))
+        broker = _broker(opts.get("broker", "default"), config=config)
         broker.create_topic(opts["topic"])
         return LogSink(broker, opts["topic"], fmt)
     if connector == "blackhole":

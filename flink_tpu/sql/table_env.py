@@ -289,7 +289,7 @@ class TableEnvironment:
             stream = stream.transform(
                 "InsertRename",
                 lambda: BatchFnOperator(rename, "InsertRename"))
-        sink = instantiate_sink(target)
+        sink = instantiate_sink(target, config=stream.env.config)
         rows = _CountingSink()
         stream.add_sink(rows.wrap(sink), f"insert-{stmt.target}")
         stream.env.execute(f"insert-{stmt.target}", timeout=timeout)

@@ -1,0 +1,44 @@
+"""Every examples/ script runs end-to-end (VERDICT r4 #10: the examples
+tree is living documentation, executed CI-style)."""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+EXAMPLES = sorted(
+    (pathlib.Path(__file__).parent.parent / "examples").glob("*.py"))
+
+
+def _load(path):
+    spec = importlib.util.spec_from_file_location(f"example_{path.stem}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.stem)
+def test_example_runs(path):
+    out = _load(path).main()
+    assert out  # every example returns a non-empty result
+
+
+def test_wordcount_counts_are_right():
+    from collections import Counter
+
+    mod = _load([p for p in EXAMPLES if p.stem == "wordcount"][0])
+    totals: Counter = Counter()
+    for word, n in mod.main():     # one row per (word, window)
+        totals[word] += int(n)
+    assert totals["be"] == 8  # 2 per repetition x 4 repetitions
+
+
+def test_nexmark_q5_topk_bounded():
+    mod = _load([p for p in EXAMPLES if p.stem == "nexmark_q5"][0])
+    hot = mod.main(n_events=20_000, n_keys=500)
+    # <= 10 rows per window fire
+    from collections import Counter
+    per_window = Counter(int(r[2]) for r in hot)
+    assert max(per_window.values()) <= 10
