@@ -59,6 +59,10 @@ class StreamExecutionEnvironment:
         self._restore_path = path
         return self
 
+    def _restore_checkpoint_pending(self) -> bool:
+        """Non-destructive peek at a staged restore point."""
+        return bool(self._restore_path)
+
     def _take_restore_checkpoint(self):
         """Consume the pending restore path -> CompletedCheckpoint."""
         if not self._restore_path:
@@ -167,7 +171,13 @@ class StreamExecutionEnvironment:
         checkpoint on task failure (requires enable_checkpointing). With a
         remote target set, the graph is submitted to the session cluster
         and this blocks until the remote job is terminal."""
+        from ..core.config import ExecutionOptions
         if self._remote_target:
+            if self.config.get(ExecutionOptions.RUNTIME_MODE) == "batch":
+                raise ValueError(
+                    "batch runtime mode runs in-process only (the remote "
+                    "dispatcher schedules pipelined streaming jobs); "
+                    "unset the remote target or the runtime mode")
             from ..cluster.dispatcher import ClusterClient
             client = ClusterClient(self._remote_target, config=self.config)
             # a pending savepoint restore ships with the submission — the
@@ -180,6 +190,21 @@ class StreamExecutionEnvironment:
             self.last_job = None
             return client.wait(job_id, timeout=timeout)
         jg = self.get_job_graph(job_name)
+        if self.config.get(ExecutionOptions.RUNTIME_MODE) == "batch":
+            # checked BEFORE consuming the pending restore point: the
+            # error must not destroy a staged savepoint restore the user
+            # will retry in streaming mode
+            if recover or self._restore_checkpoint_pending():
+                raise ValueError(
+                    "batch mode schedules stages over blocking exchanges "
+                    "and has no checkpoints to recover/restore from; "
+                    "failed bounded jobs re-run from their sources")
+            from ..cluster.batch import run_job_batch
+            self.last_job = run_job_batch(jg, self.config, timeout=timeout,
+                                          metrics_registry=metrics_registry)
+            self._transformations = []
+            self._sinks = []
+            return self.last_job
         cp = self._take_restore_checkpoint()
         if recover:
             from ..cluster.scheduler import JobSupervisor
@@ -209,6 +234,12 @@ class StreamExecutionEnvironment:
                 "a remote target is set; execute_async runs in-process — "
                 "use execute() (which submits to the cluster and waits) or "
                 "ClusterClient.submit for fire-and-forget")
+        from ..core.config import ExecutionOptions
+        if self.config.get(ExecutionOptions.RUNTIME_MODE) == "batch":
+            raise ValueError(
+                "batch runtime mode schedules stages synchronously; use "
+                "execute() — execute_async would silently run the "
+                "pipelined streaming path instead")
         from ..cluster.local import deploy_local
         jg = self.get_job_graph(job_name)
         cp = self._take_restore_checkpoint()

@@ -1,0 +1,261 @@
+"""Bounded (batch) execution: blocking exchanges, stage-by-stage
+scheduling, speculative retries.
+
+The batch half of the reference's runtime-mode split
+(flink-runtime scheduler/adaptivebatch/AdaptiveBatchScheduler.java:95,
+SpeculativeScheduler.java:89; blocking exchange:
+io/network/partition/SortMergeResultPartition.java:66), scoped to the
+local/SPMD runner:
+
+* every exchange is a BLOCKING partition (runtime/channels.py
+  ReplayableChannel): a producer vertex runs to completion and
+  materializes its entire output before any consumer task starts — the
+  scheduling granularity of batch mode, and what makes retries cheap
+  (inputs are re-readable, nothing upstream re-runs);
+* vertices are scheduled in topological stages: a vertex starts once all
+  of its input vertices finished;
+* speculative execution (behind execution.batch.speculative.enabled):
+  when a stage's median subtask has finished but a straggler keeps
+  running past ``median * multiplier``, a SECOND attempt of that subtask
+  deploys with fresh cursors over the same blocking inputs and shadow
+  output partitions; whichever attempt finishes first wins — the
+  winner's partitions become the stage output, the loser is cancelled.
+  Attempts never share operator state, so the race is safe by
+  construction.
+
+Checkpointing is meaningless for bounded stage execution (the reference
+disables it in batch mode); run_job_batch ignores any configured
+interval. Streaming jobs keep the pipelined runner (cluster/local.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..core.config import CheckpointingOptions, Configuration, \
+    ExecutionOptions
+from ..graph.stream_graph import JobGraph
+from ..runtime.channels import ReplayableChannel
+from .local import LocalJob, _deploy_vertices
+
+__all__ = ["run_job_batch"]
+
+
+def _topo_stages(job_graph: JobGraph) -> list[str]:
+    """Vertex ids in topological order (each is one scheduling stage)."""
+    order, seen = [], set()
+
+    def visit(vid: str) -> None:
+        if vid in seen:
+            return
+        seen.add(vid)
+        for e in job_graph.in_edges(vid):
+            visit(e.source_vertex)
+        order.append(vid)
+
+    for vid in job_graph.vertices:
+        visit(vid)
+    return order
+
+
+class _StageAttempt:
+    """One speculative (shadow) attempt of a single subtask."""
+
+    def __init__(self, task, shadow_channels: dict):
+        self.task = task
+        self.shadow_channels = shadow_channels  # ei -> [dst channels]
+
+
+def run_job_batch(job_graph: JobGraph, config: Configuration,
+                  timeout: Optional[float] = 120.0,
+                  metrics_registry=None) -> LocalJob:
+    """Run a bounded job stage by stage over blocking exchanges."""
+    for e in job_graph.edges:
+        if e.feedback:
+            raise ValueError("iterations cannot run in batch mode "
+                             "(a feedback edge is unbounded by nature)")
+    job = LocalJob(job_graph, config)
+    job.metrics_registry = metrics_registry
+    # speculation audit trail: [{"task", "winner"}] per settled race
+    job.speculative_attempts = []
+
+    channels: dict[int, list[list[ReplayableChannel]]] = {}
+    for ei, e in enumerate(job_graph.edges):
+        src = job_graph.vertices[e.source_vertex]
+        dst = job_graph.vertices[e.target_vertex]
+        channels[ei] = [[ReplayableChannel() for _ in range(dst.parallelism)]
+                        for _ in range(src.parallelism)]
+
+    # checkpointing is a no-op for staged bounded execution: hide any
+    # configured interval from the deployed tasks (barriers would wedge
+    # against not-yet-started stages; reference batch mode likewise
+    # disables checkpoints)
+    cfg = config.clone()
+    cfg.set(CheckpointingOptions.INTERVAL, 0.0)
+    _deploy_vertices(job, job_graph, cfg, channels, None,
+                     metrics_registry, set(job_graph.vertices))
+
+    speculative = config.get(ExecutionOptions.SPECULATIVE)
+    factor = config.get(ExecutionOptions.SPECULATIVE_FACTOR)
+    deadline = None if timeout is None else time.time() + timeout
+
+    for vid in _topo_stages(job_graph):
+        vertex = job_graph.vertices[vid]
+        task_ids = [f"{vid}#{s}" for s in range(vertex.parallelism)]
+        started_at: dict[str, float] = {}
+        for tid in task_ids:
+            now = time.time()
+            job.tasks[tid].start()
+            job._exec_set(tid, "RUNNING")
+            # the attempt's clock starts at STAGE start, not deploy time
+            # (all vertices deploy up front; scheduling is staged)
+            attempts = job.executions.get(tid)
+            if attempts:
+                attempts[-1]["start"] = now
+            started_at[tid] = now
+        shadows: dict[str, _StageAttempt] = {}
+        try:
+            _await_stage(job, job_graph, cfg, vid, vertex, task_ids,
+                         channels, started_at, shadows,
+                         speculative, factor, deadline, metrics_registry)
+        finally:
+            for att in shadows.values():
+                att.task.cancel()
+        if job._failed:
+            task_id, err = job._failed[0]
+            job.cancel()
+            raise RuntimeError(f"Task {task_id} failed: {err!r}") from err
+    job._done.set()
+    return job
+
+
+def _await_stage(job, job_graph, config, vid, vertex, task_ids, channels,
+                 started_at, shadows, speculative, factor, deadline,
+                 metrics_registry) -> None:
+    durations: dict[str, float] = {}
+    pending = set(task_ids)
+    while pending:
+        if deadline is not None and time.time() > deadline:
+            job.cancel()
+            raise TimeoutError(f"batch stage {vertex.name} timed out")
+        done_now = set()
+        for tid in pending:
+            main_done = tid in job._finished
+            shadow = shadows.get(tid)
+            shadow_done = (shadow is not None
+                           and shadow.task.task_id in
+                           shadow.task.reporter._finished)
+            if main_done or shadow_done:
+                if shadow is not None:
+                    _settle_speculation(job, job_graph, tid, shadow,
+                                        channels, winner_is_shadow=
+                                        shadow_done and not main_done)
+                    shadows.pop(tid, None)
+                if shadow_done and not main_done:
+                    # shadow completed the subtask: a failure of the
+                    # (now-cancelled) original no longer fails the job —
+                    # whichever attempt finishes first wins, either way
+                    with job._lock:
+                        job._failed = [(t, e) for t, e in job._failed
+                                       if t != tid]
+                durations[tid] = time.time() - started_at[tid]
+                done_now.add(tid)
+            elif shadow is not None and shadow.task.reporter._failed:
+                # a failed shadow never wins; drop it and let the
+                # original attempt decide the subtask's fate
+                shadow.task.cancel()
+                shadows.pop(tid, None)
+        pending -= done_now
+        # a failed ORIGINAL whose shadow is still racing does not fail
+        # the job yet — the shadow may complete the subtask
+        blocking_failures = [t for t, _e in job._failed
+                             if t not in shadows]
+        if blocking_failures:
+            return
+        if (speculative and pending and durations
+                and len(durations) * 2 >= len(task_ids)
+                and not _has_sink(vertex)):
+            med = sorted(durations.values())[len(durations) // 2]
+            for tid in list(pending):
+                if tid in shadows:
+                    continue
+                if time.time() - started_at[tid] > max(med * factor, 0.05):
+                    shadows[tid] = _spawn_shadow(job_graph, config, vid,
+                                                 tid, channels,
+                                                 metrics_registry)
+        time.sleep(0.005)
+
+
+def _has_sink(vertex) -> bool:
+    """Vertices containing a sink are never speculated: shadow channels
+    isolate inter-vertex partitions, but a sink's side effects (files,
+    collect buffers, external systems) would run in BOTH attempts — the
+    loser's writes cannot be unwound. The reference restricts speculation
+    to sinks implementing SupportsConcurrentExecutionAttempts; ours
+    declare no such contract, so all sinks are excluded."""
+    return vertex.kind == "sink" or any(
+        n.kind == "sink" for n in vertex.chained_nodes)
+
+
+def _spawn_shadow(job_graph, config, vid, task_id, channels,
+                  metrics_registry) -> _StageAttempt:
+    """Deploy attempt #2 of one subtask: same blocking inputs re-read
+    from the start (fresh cursors), outputs into shadow partitions."""
+    sub = int(task_id.rsplit("#", 1)[1])
+    shadow_job = LocalJob(job_graph, config)
+    shadow_channels: dict[int, list] = {}
+    chan_view: dict[int, list[list]] = {}
+    for ei, e in enumerate(job_graph.edges):
+        if e.source_vertex == vid:
+            # shadow outputs: fresh partitions, adopted only on a win
+            rows = []
+            for s in range(len(channels[ei])):
+                if s == sub:
+                    fresh = [ReplayableChannel()
+                             for _ in channels[ei][s]]
+                    shadow_channels[ei] = fresh
+                    rows.append(fresh)
+                else:
+                    rows.append(channels[ei][s])
+            chan_view[ei] = rows
+        elif e.target_vertex == vid:
+            # shadow inputs: new cursors over the SAME materialized data
+            chan_view[ei] = [
+                [ch.clone_reader() if d == sub else ch
+                 for d, ch in enumerate(row)]
+                for row in channels[ei]]
+        else:
+            chan_view[ei] = channels[ei]
+    # metrics_registry=None: the shadow must not share the original
+    # attempt's TaskMetrics counters — both attempts incrementing the
+    # same numRecords* would double-count the speculated subtask
+    _deploy_vertices(shadow_job, job_graph, config, chan_view, None,
+                     None, {vid})
+    task = shadow_job.tasks[task_id]
+    task.start()
+    shadow_job._exec_set(task_id, "RUNNING")
+    return _StageAttempt(task, shadow_channels)
+
+
+def _settle_speculation(job, job_graph, task_id, attempt, channels,
+                        winner_is_shadow: bool) -> None:
+    """First finished attempt wins; the loser is cancelled. On a shadow
+    win the shadow's partitions become the stage output (consumers have
+    not started yet — blocking exchanges make the swap trivial)."""
+    vid, sub = task_id.rsplit("#", 1)
+    sub = int(sub)
+    job.speculative_attempts.append(
+        {"task": task_id,
+         "winner": "speculative" if winner_is_shadow else "original"})
+    if winner_is_shadow:
+        job.tasks[task_id].cancel()
+        for ei, fresh in attempt.shadow_channels.items():
+            for d, ch in enumerate(channels[ei][sub]):
+                ch.adopt_items(fresh[d])
+        with job._lock:
+            job._exec_set(task_id, "FINISHED")
+            job._finished.add(task_id)
+    else:
+        attempt.task.cancel()

@@ -72,6 +72,65 @@ class LocalChannel(Channel):
             out.append(e)
 
 
+class ReplayableChannel(Channel):
+    """Blocking-partition channel for bounded (batch) execution: writes
+    append to a persistent list (the SortMergeResultPartition analog —
+    in-memory here), reads advance a per-reader cursor WITHOUT consuming,
+    so a speculative attempt of the consumer can re-read from the start
+    via ``clone_reader``. Unbounded by design: a blocking exchange
+    materializes the producer's whole output before the consumer starts.
+    """
+
+    def __init__(self, items: Optional[list] = None,
+                 lock: Optional[threading.Lock] = None):
+        self._items: list = items if items is not None else []
+        self._lock = lock or threading.Lock()
+        self._cursor = 0
+        self._sealed = False
+
+    def put(self, element: Any, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            if self._sealed:
+                # a speculation loser may wake from a blocking call after
+                # its race was settled; its late writes must not corrupt
+                # the adopted partition
+                return True
+            self._items.append(element)
+        return True
+
+    def poll(self) -> Optional[Any]:
+        with self._lock:
+            if self._cursor >= len(self._items):
+                return None
+            e = self._items[self._cursor]
+            self._cursor += 1
+            return e
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items) - self._cursor
+
+    def drain(self) -> list:
+        with self._lock:
+            out = self._items[self._cursor:]
+            self._cursor = len(self._items)
+            return out
+
+    # -- batch-mode extensions ------------------------------------------
+    def clone_reader(self) -> "ReplayableChannel":
+        """A fresh cursor over the SAME partition (speculative re-read)."""
+        return ReplayableChannel(self._items, self._lock)
+
+    def adopt_items(self, other: "ReplayableChannel") -> None:
+        """Replace this partition's contents with another attempt's output
+        and SEAL it against the losing attempt's late writes (the
+        speculation winner's partition becomes THE partition)."""
+        with self._lock:
+            self._sealed = True
+            self._items[:] = list(other._items)
+            self._cursor = 0
+
+
 @dataclass
 class GateEvent:
     """What the gate hands the task: either data/watermark to process, a fully
