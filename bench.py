@@ -761,7 +761,51 @@ def suite() -> None:
     kernel = bench_device()
     _line("q5_kernel_ceiling_events_per_sec_1M_keys", kernel,
           "events/sec/chip", kernel / host_eps)
+    bench_topk_ab()
     _print_tunnel()
+
+
+def bench_topk_ab() -> None:
+    """A/B the fire-path top-k: XLA radix select (16-bit digits,
+    scatter-add histograms) vs the Pallas kernel (8-bit digits, one-hot
+    VPU histograms) on identical shapes — VERDICT r4 #7: measure, keep
+    the winner, record the number. The Pallas build needs the real TPU;
+    on CPU fallback only the XLA side runs (interpret mode would time
+    the interpreter, not the kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops.pallas_topk import masked_topk_pallas, \
+        pallas_available
+    from flink_tpu.ops.topk import masked_topk
+
+    rng = np.random.default_rng(0)
+    for cap, label in ((1 << 21, "2M"), (1 << 24, "16M")):
+        vals = jnp.asarray(rng.integers(0, 1 << 31, cap).astype(np.int64))
+        valid = jnp.asarray(rng.random(cap) < 0.5)
+
+        def timed(fn):
+            out = fn(vals, valid, 1000, value_bits=32)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = fn(vals, valid, 1000, value_bits=32)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / 5 * 1e3
+
+        xla_ms = timed(masked_topk)
+        _line(f"topk_ab_xla_ms_{label}", xla_ms, "ms", 1.0)
+        if pallas_available():
+            try:
+                pl_ms = timed(masked_topk_pallas)
+                _line(f"topk_ab_pallas_ms_{label}", pl_ms, "ms",
+                      xla_ms / pl_ms if pl_ms else 0.0)
+            except Exception as e:  # noqa: BLE001 - record, don't die
+                _line(f"topk_ab_pallas_ms_{label}", 0.0, "ms", 0.0,
+                      error=f"{type(e).__name__}: {e}"[:200])
+        else:
+            _line(f"topk_ab_pallas_ms_{label}", 0.0, "ms", 0.0,
+                  skipped="pallas needs the real TPU backend")
 
 
 if __name__ == "__main__":

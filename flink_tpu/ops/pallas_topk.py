@@ -1,0 +1,157 @@
+"""Pallas TPU kernel for the top-k radix-select histogram (A/B vs XLA).
+
+The fire-path top-k (ops/topk.py) is O(n) histogram passes; under XLA
+each pass lowers to a scatter-add — correct, but scatter is the op XLA
+lowers most conservatively on TPU. This module implements the same
+histogram as a Pallas kernel using the TPU-native formulation: per-block
+ONE-HOT expansion + reduction (compare-and-sum runs on the VPU/MXU at
+full vector width; no scatter at all), accumulated across grid steps in
+VMEM.
+
+The kernel uses 8-bit digits (256 bins) so the one-hot block stays small
+in VMEM ([block, 256] int32 = 2 MB at block 2048); a 32-bit walk is <= 4
+passes instead of the XLA path's <= 2 passes of 16-bit digits — the A/B
+(bench.py: topk_ab_* metrics) decides which wins on real hardware, per
+VERDICT r4 #7: measure, keep the winner, record the number.
+
+``masked_topk_pallas`` matches ``ops.topk.masked_topk``'s contract for
+non-negative integer domains below 2^32 (the count/packed-word fires);
+other dtypes fall back to the XLA path. ``interpret=True`` runs the
+kernel in the Pallas interpreter for CPU correctness tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["histogram256_pallas", "masked_topk_pallas",
+           "pallas_available"]
+
+_BLOCK = 2048
+
+
+def _hist_kernel(u_ref, valid_ref, out_ref, *, shift: int):
+    """One grid step: 256-bin histogram of ((u >> shift) & 0xFF) over a
+    [BLOCK] slice, masked by ``valid``, accumulated into out_ref[8, 256]
+    (rows summed by the caller; 8 rows keep the int32 tile shape)."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    u = u_ref[:]                                       # [BLOCK] int32
+    ids = jax.lax.shift_right_logical(
+        u, jnp.int32(shift)) & jnp.int32(0xFF)
+    ids3 = ids.reshape(_BLOCK // 8, 8, 1)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 256), 2)
+    onehot = (ids3 == bins).astype(jnp.int32)          # [B/8, 8, 256]
+    mask = valid_ref[:].reshape(_BLOCK // 8, 8, 1).astype(jnp.int32)
+    out_ref[:, :] += (onehot * mask).sum(axis=0)
+
+
+@partial(jax.jit, static_argnames=("shift", "interpret"))
+def histogram256_pallas(u: jax.Array, valid: jax.Array, shift: int,
+                        interpret: bool = False) -> jax.Array:
+    """[256] int32 histogram of ((u >> shift) & 0xFF) where valid."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = u.shape[0]
+    P = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if P != n:
+        u = jnp.concatenate([u, jnp.zeros(P - n, u.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros(P - n, valid.dtype)])
+    grid = (P // _BLOCK,)
+    out = pl.pallas_call(
+        partial(_hist_kernel, shift=shift),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK,), lambda i: (i,)),
+            pl.BlockSpec((_BLOCK,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((8, 256), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 256), jnp.int32),
+        interpret=interpret,
+    )(u.astype(jnp.int32), valid.astype(jnp.int32))
+    return out.sum(axis=0)
+
+
+def masked_topk_pallas(values: jax.Array, valid: jax.Array, k: int,
+                       value_bits: int = 32, interpret: bool = False):
+    """Exact masked top-k via Pallas histogram radix select (8-bit
+    digits). Contract identical to ops.topk.masked_topk for non-negative
+    integer domains < 2^32; other inputs take the XLA path."""
+    from .topk import masked_topk
+
+    if (value_bits > 32
+            or jnp.issubdtype(jnp.asarray(values).dtype, jnp.floating)):
+        return masked_topk(values, valid, k, value_bits)
+    passes = max(1, -(-value_bits // 8))
+    return _topk_pallas(values, valid, k, passes, interpret)
+
+
+@partial(jax.jit, static_argnames=("k", "passes", "interpret"))
+def _topk_pallas(values, valid, k, passes, interpret):
+    n = values.shape[0]
+    k = min(k, n)
+    u = values.astype(jnp.uint32)
+    nvalid = jnp.sum(valid, dtype=jnp.int32)
+    kk = jnp.minimum(jnp.int32(k), nvalid)
+    cand = valid
+    above = jnp.int32(0)
+    prefix = jnp.uint32(0)
+    bins = jnp.arange(256, dtype=jnp.int32)
+    for shift in (24, 16, 8, 0)[4 - passes:]:
+        hist = histogram256_pallas(u.view(jnp.int32)
+                                   if u.dtype == jnp.uint32 else u,
+                                   cand, shift, interpret=interpret)
+        revcum = jnp.cumsum(hist[::-1])[::-1]
+        cond = (above + revcum) >= kk
+        bstar = jnp.max(jnp.where(cond, bins, -1))
+        above = above + jnp.where(bins > bstar, hist, 0).sum()
+        prefix = prefix | (bstar.astype(jnp.uint32) << shift)
+        field = ((u >> shift) & jnp.uint32(0xFF)).astype(jnp.int32)
+        cand = cand & (field == bstar)
+    thr = prefix
+    strict = valid & (u > thr)
+    tie = valid & (u == thr)
+    cum_s = jnp.cumsum(strict.astype(jnp.int32))
+    cum_t = jnp.cumsum(tie.astype(jnp.int32))
+    tie_pos = jnp.clip(jnp.int32(k) - cum_t, 0, k - 1)
+    strict_pos = cum_s - 1
+    idx = jnp.arange(n, dtype=jnp.int32)
+    buf_i = jnp.full(k, -1, jnp.int32)
+    buf_i = buf_i.at[jnp.where(tie, tie_pos, k)].set(idx, mode="drop")
+    buf_i = buf_i.at[jnp.where(strict, strict_pos, k)].set(idx, mode="drop")
+    filled = buf_i >= 0
+    sent = jnp.iinfo(values.dtype).min
+    buf_v = jnp.where(filled, values[jnp.maximum(buf_i, 0)], sent)
+    order = jnp.lexsort((jnp.where(filled, buf_v.astype(jnp.uint32),
+                                   jnp.uint32(0)), filled))[::-1]
+    return (buf_v[order], jnp.maximum(buf_i, 0)[order].astype(jnp.int64),
+            filled[order])
+
+
+def _probe() -> bool:
+    """Can a trivial Pallas kernel compile on this backend?"""
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+        histogram256_pallas(jnp.zeros(256, jnp.int32),
+                            jnp.ones(256, jnp.int32), 0)
+        return True
+    except Exception:  # noqa: BLE001 - absence of pallas support
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available() -> bool:
+    return _probe()

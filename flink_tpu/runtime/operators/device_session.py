@@ -27,8 +27,13 @@ A session window [start, last_ts + gap) fires when the watermark passes
 its end, as one compiled scan over the [L, capacity] planes that
 compacts (key, start, end, aggregates) and resets fired lanes.
 
-Semantics vs the host operator (exact for in-order and for disorder
-bounded by ``gap``):
+Segments only bypass the lanes into the host pending buffer once they
+are SETTLED — no event that is still non-late could merge into them
+(end + 2*gap behind the fired boundary); anything fresher keeps a lane,
+where out-of-order events find it through the all-lanes merge probe.
+
+Semantics vs the host operator (exact for in-order input and for
+arbitrary NON-late disorder, except the bridge case below):
   * allowed_lateness = 0: an event whose merged window would end at or
     behind the fired boundary is dropped and counted, like the device
     pane operator;
@@ -120,11 +125,12 @@ def _sess_step(fold_sig: tuple, lanes: int, gap: int, dirty_block: int):
         is_anchor = valid & (first | in_jump)
         # ---- two-level fold: events -> per-SEGMENT accumulators --------
         # every anchor opens a batch-local segment; events fold into [B]
-        # segment buffers first. Segments already gap-CLOSED inside the
-        # batch (every segment except each key's last) can never be
-        # extended by later in-order input — they bypass the lanes
-        # entirely and compact straight into the pending-emission buffers,
-        # so lane pressure is <= ONE allocation per key per batch.
+        # segment buffers first. Only SETTLED segments (no non-late event
+        # can still merge into them; see the classification below) bypass
+        # the lanes into the pending-emission buffers — every other
+        # segment takes a lane, so a key may allocate SEVERAL lanes per
+        # batch and `lanes` must cover its maximum concurrently-open
+        # (unsettled) sessions.
         idx = jnp.arange(B, dtype=jnp.int32)
         last_anchor = jax.lax.cummax(jnp.where(is_anchor, idx, -1))
         seg_ok = valid & (last_anchor >= 0)
@@ -157,20 +163,39 @@ def _sess_step(fold_sig: tuple, lanes: int, gap: int, dirty_block: int):
         # is this segment its key's LAST in the batch?
         lastseg = jnp.full(cap + 1, -1, jnp.int32).at[
             jnp.where(seg_here, kslot, cap).astype(jnp.int32)].max(idx)
-        seg_is_last = seg_here & (idx == lastseg[widx0])
-        # classify
-        seg_to_lane = seg_here & (smerge | seg_is_last)
-        seg_emit = seg_here & ~smerge & ~seg_is_last
-        # ONE allocation per key per batch: first FREE lane after cur
+        seg_is_last = jnp.asarray(seg_here & (idx == lastseg[widx0]))
+        # classify: a segment bypasses the lanes ONLY when it is SETTLED —
+        # every event that could still merge into it (ts < end + gap and
+        # within gap of it) is already late (ts + gap <= fired_boundary),
+        # i.e. end + 2*gap <= fired_boundary. Eagerly finalizing merely
+        # gap-closed-IN-BATCH segments (the old rule) split sessions for
+        # out-of-order but NON-late events: the segment sat in the host
+        # pending buffer where no later event could reach it (ADVICE r4
+        # medium). Unsettled middle segments now take lanes too.
+        settled = send + jnp.int64(2 * gap) <= fired_boundary
+        seg_to_lane = seg_here & (smerge | seg_is_last | ~settled)
+        seg_emit = seg_here & ~smerge & ~seg_is_last & settled
+        # lane allocation, j-th free lane for a key's j-th new segment
+        # (sorted batch => a key's segments are contiguous; their ordinals
+        # index into the key's free-lane rotation, so several unsettled
+        # segments of one key land on distinct lanes in one batch)
+        need_alloc = seg_to_lane & ~smerge
+        cs = jnp.cumsum(need_alloc.astype(jnp.int32))
+        base = jnp.zeros(cap + 1, jnp.int32).at[
+            jnp.where(first, kslot, cap).astype(jnp.int32)].max(
+            cs - need_alloc.astype(jnp.int32), mode="drop")
+        ordn = jnp.where(need_alloc, cs - base[widx0] - 1, 0)
         cl = cur_lane[gs]
         open_bl = jnp.stack([planes["__open__"][ln, gs] > 0
                              for ln in range(L)], axis=1)     # [B, L]
         rot = (cl[:, None] + 1
                + jnp.arange(L, dtype=jnp.int32)[None, :]) % L
         rot_free = ~jnp.take_along_axis(open_bl, rot, axis=1)
+        free_rank = jnp.cumsum(rot_free.astype(jnp.int32), axis=1)
+        pick = rot_free & (free_rank == (ordn + 1)[:, None])
         alloc_lane = jnp.take_along_axis(
-            rot, jnp.argmax(rot_free, axis=1)[:, None], axis=1)[:, 0]
-        no_free = seg_is_last & ~smerge & ~rot_free.any(axis=1)
+            rot, jnp.argmax(pick, axis=1)[:, None], axis=1)[:, 0]
+        no_free = need_alloc & ~pick.any(axis=1)
         overflow = jnp.sum(no_free).astype(jnp.int64)
         dropped = dropped + overflow
         seg_to_lane = seg_to_lane & ~no_free

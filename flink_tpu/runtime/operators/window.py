@@ -248,6 +248,8 @@ class WindowOperator(OneInputOperator):
         if self._aggregate is not None and hasattr(self._aggregate, "bind_schema"):
             self._aggregate.bind_schema(batch.schema)
         keys = self._key_extractor(batch)
+        if self._process_batch_grouped(batch, keys):
+            return
         for i in range(batch.n):
             key = keys[i]
             key = key.item() if isinstance(key, np.generic) else key
@@ -286,6 +288,107 @@ class WindowOperator(OneInputOperator):
                         LATE_DATA_TAG,
                         RecordBatch.from_rows(batch.schema, [row], [ts]))
         self._flush_pending()
+
+    def _process_batch_grouped(self, batch: RecordBatch, keys) -> bool:
+        """Grouped fast path for the common window shape — non-merging
+        event-time assigner, default EventTimeTrigger, incremental
+        aggregation, no evictor, allowed_lateness 0: ONE state resolution
+        and ONE timer registration per distinct (key, window) per batch
+        instead of per record, with numpy partial folds for builtin
+        aggregates (the host twin of the device operator's batch fold;
+        reference shape: MiniBatch windowed aggregation). Returns False
+        when the configuration needs the per-record path."""
+        from ...window.assigners import (
+            SlidingEventTimeWindows, TumblingEventTimeWindows,
+        )
+        from ...window.triggers import EventTimeTrigger
+
+        a = self._assigner
+        if (a.is_merging or self._evictor is not None
+                or self._contents_desc.kind != "aggregating"
+                or type(self._trigger) is not EventTimeTrigger
+                or not a.is_event_time
+                or self._allowed_lateness != 0
+                or batch.n == 0):
+            return False
+        if isinstance(a, TumblingEventTimeWindows):
+            size, slide, offset = a.size, a.size, a.offset
+        elif isinstance(a, SlidingEventTimeWindows):
+            size, slide, offset = a.size, a.slide, a.offset
+            if size % slide != 0:
+                return False
+        else:
+            return False
+        ts = batch.timestamps
+        if bool((ts == MIN_TIMESTAMP).any()):
+            return False
+        nwin = size // slide
+        last_start = (ts - ((ts - offset) % slide)).astype(np.int64)
+        wm = self.current_watermark
+        # vectorizable builtin fold? (sum/min/max/count over one column)
+        bk = getattr(self._aggregate, "builtin_kind", None)
+        bf = getattr(self._aggregate, "builtin_field", None)
+        col = None
+        if bk in ("sum", "min", "max") or (bk == "count" and bf is None):
+            if bk == "count":
+                col = np.ones(batch.n, np.int64)
+            elif isinstance(bf, str) and bf in batch.schema:
+                col = np.asarray(batch.column(bf))
+            elif isinstance(bf, int):
+                col = np.asarray(
+                    batch.columns[batch.schema.fields[bf].name])
+            elif isinstance(bf, str) and len(batch.schema) == 1:
+                col = np.asarray(batch.column(batch.schema.fields[0].name))
+            if col is not None and col.dtype == object:
+                col = None
+        rows = None if col is not None else list(batch.iter_rows())
+        # group (key, window_start) -> row indices; at lateness 0 the
+        # EventTimeTrigger never fires on add (a passed window is late),
+        # so grouping changes no observable behavior, only the number of
+        # state/namespace round-trips
+        groups: dict = {}
+        newest_late = None
+        for j in range(nwin):
+            starts = last_start - j * slide
+            late = starts + size - 1 <= wm
+            if j == 0:
+                newest_late = late
+            for i in np.flatnonzero(~late):
+                k = keys[i]
+                k = k.item() if isinstance(k, np.generic) else k
+                groups.setdefault((k, int(starts[i])), []).append(i)
+        if self._emit_late_data and newest_late is not None \
+                and newest_late.any():
+            idx = np.flatnonzero(newest_late)
+            self.output.emit_side(LATE_DATA_TAG, batch.take(idx))
+        reducers = {"sum": np.sum, "min": np.min, "max": np.max,
+                    "count": np.sum}
+        reduce_fn = reducers[bk] if col is not None else None
+        backend = self._backend
+        # state handles read the backend's CURRENT key/namespace at access
+        # time, so one handle serves every group (resolving it per group
+        # was ~15% of this loop)
+        backend.set_current_namespace(TimeWindow(0, size))
+        contents = backend.get_partitioned_state(self._contents_desc)
+        can_merge = hasattr(contents, "merge_accumulator")
+        register = self._timers.register_event_time_timer
+        for (key, start), idxs in groups.items():
+            window = TimeWindow(start, start + size)
+            backend.set_current_key(key)
+            backend.set_current_namespace(window)
+            if col is not None and can_merge:
+                part = reduce_fn(col[idxs])
+                contents.merge_accumulator(
+                    part.item() if isinstance(part, np.generic) else part)
+            else:
+                for i in idxs:
+                    contents.add(rows[i])
+            # at allowed_lateness 0 the trigger's fire timer and the
+            # cleanup timer are ONE timer at window.max_timestamp (the
+            # per-record path documents the same collapse)
+            register(key, window.max_timestamp, window)
+        self._flush_pending()
+        return True
 
     # -- firing ------------------------------------------------------------
     def _handle_trigger_result(self, key, window, result: TriggerResult) -> None:
@@ -358,7 +461,10 @@ class WindowOperator(OneInputOperator):
         if (event_time == self._assigner.is_event_time
                 and timer.timestamp == self._cleanup_time(window)):
             self._clear_all_state(key, window)
-        self._flush_pending()
+        # no flush here: one watermark advance fires MANY timers (every
+        # closed window of every key) and process_watermark flushes once
+        # after the sweep — a per-timer flush built a one-row RecordBatch
+        # per fired window
 
     def _fire_via_trigger(self, key, window, ts: int, event_time: bool) -> None:
         ctx = self._trigger_ctx(key, window)

@@ -185,3 +185,48 @@ class TestCheckpoint:
         # key 1: 100..150 merge -> [100, 250) sum 12; key 2: two sessions
         assert got == {(1, 100, 250, 12, 2), (2, 110, 210, 6, 1),
                        (2, 400, 500, 8, 1)}
+
+
+class TestOutOfOrderNonLateMerge:
+    """ADVICE r4 medium: an out-of-order but NON-late event overlapping a
+    segment that closed inside an earlier batch must merge into it (the
+    old eager finalization parked such segments in the host pending
+    buffer where nothing could reach them, emitting split sessions)."""
+
+    def test_event_merges_into_in_batch_closed_segment(self):
+        gap = 50
+        # batch 1: key 7 forms TWO in-batch segments [100,110], [200,210]
+        batches = [
+            ([(7, 1), (7, 1), (7, 1), (7, 1)], [100, 110, 200, 210]),
+            # batch 2: t=130 is out of order (behind 210) but NOT late
+            # (watermark is still 0) and overlaps [100,110]'s gap window
+            ([(7, 1)], [130]),
+        ]
+        wms = [0, 0]
+        host = _host(gap, batches, wms)
+        dev, _op = _device(gap, batches, wms)
+        assert dev == host
+        # the merged first session spans [100, 130 + gap)
+        assert (7, 100, 130 + gap, 3, 3) in dev
+
+    def test_random_gap_bounded_disorder_parity(self):
+        rng = np.random.default_rng(17)
+        gap = 40
+        n = 400
+        keys = rng.integers(0, 12, n).astype(np.int64)
+        base = np.sort(rng.integers(0, 4000, n)).astype(np.int64)
+        ts = base + rng.integers(-35, 35, n)   # disorder < gap
+        ts = np.maximum(ts, 0)
+        rows = [(int(k), 1) for k in keys]
+        # two batches with a mid-stream watermark far enough back that
+        # nothing is late
+        half = n // 2
+        batches = [(rows[:half], ts[:half].tolist()),
+                   (rows[half:], ts[half:].tolist())]
+        wms = [int(ts[:half].max()) - 200, int(ts.max())]
+        host = _host(gap, batches, wms)
+        # unsettled segments occupy lanes until the watermark settles
+        # them, so lane budget must cover a batch's worth of per-key
+        # sessions (the operator raises loudly when it cannot)
+        dev, _op = _device(gap, batches, wms, lanes=64)
+        assert dev == host
