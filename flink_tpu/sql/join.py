@@ -225,7 +225,12 @@ class IntervalJoinOperator(TwoInputOperator):
     def __init__(self, key_index1: int, key_index2: int, lower_ms: int,
                  upper_ms: int, out_schema: Schema,
                  join_type: str = "inner", rows_per_key: int = 256,
+                 store_capacity: int = 1 << 12,
                  name: str = "IntervalJoin"):
+        """``store_capacity``: initial key slots per side's device list
+        store; pre-sizing to the expected key count avoids rehash
+        round-trips AND keeps program shapes constant (every capacity
+        change recompiles the append/probe/prune executables)."""
         super().__init__(name)
         if join_type != "inner":
             raise NotImplementedError(
@@ -236,6 +241,7 @@ class IntervalJoinOperator(TwoInputOperator):
         self.upper = upper_ms
         self.out_schema = out_schema
         self.rows_per_key = int(rows_per_key)
+        self.store_capacity = int(store_capacity)
         # host plane: kg -> key -> list[(ts, row)] per side
         self.buffers: tuple[dict, dict] = ({}, {})
         # device plane (tpu backend + numeric schemas): per-side
@@ -298,6 +304,7 @@ class IntervalJoinOperator(TwoInputOperator):
             self._stores[side] = DeviceListStore(
                 self.ctx.key_group_range, self.ctx.max_parallelism,
                 [np.dtype(f.dtype) for f in schema.fields],
+                capacity=self.store_capacity,
                 rows_per_key=self.rows_per_key)
         return self._stores[side]
 
@@ -416,7 +423,8 @@ class IntervalJoinOperator(TwoInputOperator):
                 self._stores[side] = DeviceListStore.from_snapshots(
                     self.ctx.key_group_range, self.ctx.max_parallelism,
                     self._restored_device.pop(side),
-                    rows_per_key=self.rows_per_key)
+                    rows_per_key=self.rows_per_key,
+                    capacity=self.store_capacity)
             self._device = True
 
 

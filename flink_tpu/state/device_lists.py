@@ -40,17 +40,22 @@ from ..ops.hash_table import EMPTY_KEY, ensure_x64, lookup, \
 __all__ = ["DeviceListStore"]
 
 
-@jax.jit
-def _append_prog(table, rows, counts, keys, packed):
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _append_prog(table, rows, counts, keys, packed, n_valid):
     """Append one packed row per key; duplicate keys within the batch take
-    consecutive positions (stable in-batch order)."""
+    consecutive positions (stable in-batch order). Rows at/after
+    ``n_valid`` are power-of-two padding (constant shapes keep one
+    executable across variable batch lengths) and write nothing."""
     B = keys.shape[0]
     cap, L, _C = rows.shape
+    valid = jnp.arange(B) < n_valid
     keys = sanitize_keys_device(keys)
-    table, slots, ok = lookup_or_insert(table, keys)
-    # rank of i among batch rows sharing its slot (stable)
-    order = jnp.argsort(slots, stable=True)
-    ss = slots[order]
+    table, slots, ok = lookup_or_insert(table, keys, valid)
+    # rank of i among VALID batch rows sharing its slot (stable); invalid
+    # rows sort to the virtual slot `cap` so they never claim positions
+    rslot = jnp.where(ok, slots, cap).astype(jnp.int32)
+    order = jnp.argsort(rslot, stable=True)
+    ss = rslot[order]
     first = jnp.searchsorted(ss, ss, side="left")
     rank_sorted = jnp.arange(B, dtype=jnp.int32) - first.astype(jnp.int32)
     rank = jnp.zeros(B, jnp.int32).at[order].set(rank_sorted)
@@ -62,9 +67,10 @@ def _append_prog(table, rows, counts, keys, packed):
         packed, mode="drop").reshape(cap, L, -1)
     counts = counts.at[jnp.where(can, sc, cap)].add(1, mode="drop")
     list_full = jnp.any(ok & (pos >= L))
-    insert_failed = jnp.any(~ok)
+    insert_failed = jnp.any(valid & ~ok)
     occ = (table != jnp.int64(EMPTY_KEY)).sum()
-    return table, rows, counts, list_full, insert_failed, occ
+    failed_rows = valid & ~ok
+    return table, rows, counts, list_full, insert_failed, occ, failed_rows
 
 
 @jax.jit
@@ -81,7 +87,7 @@ def _probe_gather(rows, sc, l_eff):
     return rows[sc, :l_eff, :]
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(1, 2))
 def _prune_prog(table, rows, counts, horizon, ts_col):
     """Compact every key's list to rows with ts >= horizon (ts stored in
     column ``ts_col`` of the packed block). Also reports occupancy and
@@ -126,6 +132,10 @@ class DeviceListStore:
         self.table = make_table(cap)
         self.rows = jnp.zeros((cap, self.L, self.C), jnp.int64)
         self.counts = jnp.zeros(cap, jnp.int32)
+        self._occ = 0   # host-tracked occupancy (insert-only table)
+        # lower bound on the oldest live row's ts: prune() is a whole-
+        # block permutation, skipped when it provably cannot drop a row
+        self._min_ts: Optional[int] = None
 
     # -- packing -------------------------------------------------------
     def _pack(self, ts: np.ndarray, cols: Sequence[np.ndarray]) -> np.ndarray:
@@ -152,26 +162,57 @@ class DeviceListStore:
     # -- operations ----------------------------------------------------
     def append_batch(self, keys: np.ndarray, ts: np.ndarray,
                      cols: Sequence[np.ndarray]) -> None:
-        packed = jnp.asarray(self._pack(ts, cols))
-        dkeys = jnp.asarray(np.asarray(keys, np.int64))
-        while True:
-            table, rows, counts, list_full, insert_failed, occ = \
-                _append_prog(self.table, self.rows, self.counts, dkeys,
-                             packed)
-            full_h, failed_h, occ_h = jax.device_get(
-                (list_full, insert_failed, occ))
-            if bool(full_h):
-                raise RuntimeError(
-                    f"device list overflow: a key exceeded {self.L} live "
-                    "rows; raise rows_per_key or tighten the retention "
-                    "window")
-            if bool(failed_h):
-                self._rehash(self.capacity * 2)
-                continue
-            self.table, self.rows, self.counts = table, rows, counts
-            if int(occ_h) > 0.6 * self.capacity:
-                self._rehash(self.capacity * 2)
+        from ..ops.segment_ops import pow2_ceil
+
+        n = len(keys)
+        if n == 0:
             return
+        P = pow2_ceil(n)
+        packed_np = self._pack(ts, cols)
+        keys_np = np.asarray(keys, np.int64)
+        if P != n:   # constant shapes: one executable per pow2 bucket
+            packed_np = np.concatenate(
+                [packed_np, np.zeros((P - n, self.C), np.int64)])
+            keys_np = np.concatenate(
+                [keys_np, np.zeros(P - n, np.int64)])
+        tmin = int(np.min(ts)) if len(ts) else None
+        if tmin is not None:
+            self._min_ts = (tmin if self._min_ts is None
+                            else min(self._min_ts, tmin))
+        # pre-grow: the append program donates its state buffers (the
+        # [cap, L, C] block would otherwise be COPIED per batch — 100s of
+        # MB), so a failed insert cannot retry against the original
+        # state; growing while the worst case (every key new) still fits
+        # under the load threshold keeps inserts infallible instead
+        while self._occ + n > 0.6 * self.capacity:
+            self._rehash(self.capacity * 2)
+        packed = jnp.asarray(packed_np)
+        dkeys = jnp.asarray(keys_np)
+        table, rows, counts, list_full, insert_failed, occ, failed_rows = \
+            _append_prog(self.table, self.rows, self.counts, dkeys,
+                         packed, np.int64(n))
+        self.table, self.rows, self.counts = table, rows, counts
+        full_h, failed_h, occ_h = jax.device_get(
+            (list_full, insert_failed, occ))
+        self._occ = int(occ_h)
+        if bool(full_h):
+            raise RuntimeError(
+                f"device list overflow: a key exceeded {self.L} live "
+                "rows; raise rows_per_key or tighten the retention "
+                "window")
+        if bool(failed_h):
+            # probe-cluster longer than the bounded walk (possible below
+            # the load threshold with adversarial key hashes): the batch
+            # rows that DID insert are already applied, so grow the table
+            # and retry only the failed subset — the mask stays on device
+            # unless this rare path runs
+            sel = np.flatnonzero(np.asarray(jax.device_get(failed_rows)))
+            sel = sel[sel < n]
+            self._rehash(self.capacity * 2)
+            self.append_batch(keys_np[sel], np.asarray(ts, np.int64)[sel]
+                              if len(ts) else np.zeros(0, np.int64),
+                              [np.asarray(c)[sel] for c in cols])
+        return
 
     def probe_batch(self, keys: np.ndarray
                     ) -> tuple[np.ndarray, np.ndarray]:
@@ -184,15 +225,23 @@ class DeviceListStore:
         positions >= counts[b] yourself."""
         from ..ops.segment_ops import pow2_ceil
 
+        n = len(keys)
+        if n == 0:
+            return np.zeros((0, 0, self.C), np.int64), \
+                np.zeros(0, np.int32)
+        P = pow2_ceil(n)
+        keys_np = np.asarray(keys, np.int64)
+        if P != n:   # constant shapes (see append_batch)
+            keys_np = np.concatenate([keys_np, np.zeros(P - n, np.int64)])
         sc, cnt = _probe_slots(self.table, self.counts,
-                               jnp.asarray(np.asarray(keys, np.int64)))
-        counts = np.asarray(jax.device_get(cnt))
+                               jnp.asarray(keys_np))
+        counts = np.asarray(jax.device_get(cnt))[:n]
         mx = int(counts.max()) if len(counts) else 0
         if mx == 0:
-            return np.zeros((len(counts), 0, self.C), np.int64), counts
+            return np.zeros((n, 0, self.C), np.int64), counts
         l_eff = min(pow2_ceil(mx), self.L)
         rows = jax.device_get(_probe_gather(self.rows, sc, l_eff))
-        return np.asarray(rows), counts
+        return np.asarray(rows)[:n], counts
 
     def prune(self, horizon: int) -> None:
         """Drop every row with ts < horizon (watermark cleanup) — one
@@ -200,8 +249,11 @@ class DeviceListStore:
         emptied) dominate, the hash table is rebuilt without them so an
         unbounded key domain cannot grow HBM without bound (the host
         plane's per-watermark `del kmap[key]`)."""
+        if self._min_ts is not None and self._min_ts >= horizon:
+            return      # provably nothing to drop: skip the permutation
         self.rows, self.counts, occ, dead = _prune_prog(
             self.table, self.rows, self.counts, np.int64(horizon), 0)
+        self._min_ts = int(horizon)
         occ_h, dead_h = jax.device_get((occ, dead))
         if int(dead_h) > 64 and int(dead_h) * 2 > int(occ_h):
             t = np.asarray(jax.device_get(self.table))
@@ -227,6 +279,7 @@ class DeviceListStore:
         self.table = make_table(self.capacity)
         self.rows = jnp.zeros((self.capacity, self.L, self.C), jnp.int64)
         self.counts = jnp.zeros(self.capacity, jnp.int32)
+        self._occ = len(keys)
         if len(keys) == 0:
             return
         self.table, slots, ok = lookup_or_insert(
@@ -254,13 +307,16 @@ class DeviceListStore:
     @classmethod
     def from_snapshots(cls, key_group_range: KeyGroupRange,
                        max_parallelism: int, snapshots: list[dict],
-                       rows_per_key: Optional[int] = None
-                       ) -> "DeviceListStore":
+                       rows_per_key: Optional[int] = None,
+                       capacity: int = 1 << 12) -> "DeviceListStore":
         """Rebuild a store purely from its snapshots (the consuming side
-        may restore before ever seeing a live batch of that input)."""
+        may restore before ever seeing a live batch of that input).
+        ``capacity`` honors the operator's pre-sizing so a restore from
+        an early (small) checkpoint does not re-walk the rehash ladder."""
         dtypes = [np.dtype(d) for d in snapshots[0]["dtypes"]]
         L = rows_per_key or max(int(s["L"]) for s in snapshots)
         store = cls(key_group_range, max_parallelism, dtypes,
+                    capacity=capacity,
                     rows_per_key=max(L, max(int(s["L"])
                                             for s in snapshots)))
         store.restore(snapshots)
