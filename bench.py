@@ -145,13 +145,13 @@ def _find_ops(env, cls):
     return ops
 
 
-def _n_panes(n_events: int) -> int:
+def _n_panes(n_events: int, batch: int = BATCH) -> int:
     """Panes sized so the WHOLE stream's event-time span plus the sliding
     window's 4-pane tail fits inside the RING-slot accumulator ring with
     headroom: worst-case open span = n_panes + 4 must stay < RING even if
     fire retirement lags ingest completely (slow chip / congested tunnel /
     CPU fallback). RING-7 panes -> max open span RING-3."""
-    return max(4, min(RING - 7, n_events // BATCH))
+    return max(4, min(RING - 7, n_events // batch))
 
 
 def _collect_stages(env) -> dict:
@@ -173,10 +173,40 @@ def _collect_stages(env) -> dict:
     return stages
 
 
+def _collect_metrics(env, before: dict) -> dict:
+    """Device-path observability snapshot embedded in every stage report:
+    compile accounting from the process-global program caches (cumulative
+    — the same series prometheus_text exposes), this run's recompile
+    delta, transfer totals, and the job's busy/backpressure ratios from
+    the per-subtask mailbox timers."""
+    from flink_tpu.metrics import DEVICE_STATS
+
+    snap = DEVICE_STATS.snapshot()
+    out = {k: snap[k] for k in ("compiles", "compile_cache_hits",
+                                "compile_ms", "h2d_bytes", "h2d_records",
+                                "d2h_bytes", "d2h_records")}
+    out["recompiles"] = snap["compiles"] - before.get("compiles", 0)
+    busy = bp = elapsed = 0.0
+    for task in env.last_job.tasks.values():
+        t = getattr(task, "io_timers", None)
+        if t is None:
+            continue
+        busy += max(0.0, t.busy_s - t.backpressured_s)
+        bp += t.backpressured_s
+        elapsed += t.elapsed_s
+    out["busy_time_ratio"] = round(busy / elapsed, 4) if elapsed else 0.0
+    out["backpressured_time_ratio"] = (round(bp / elapsed, 4)
+                                       if elapsed else 0.0)
+    return out
+
+
 def _run_q5(n_keys: int, n_events: int, capacity: int,
-            pane_ms: int = 2000, topk: int = 1000, device: bool = True):
+            pane_ms: int = 2000, topk: int = 1000, device: bool = True,
+            batch: int = BATCH, metrics_registry=None):
     """One env.execute() of the Q5 pipeline; returns (wall_seconds,
-    fire_latencies_ms, emitted_rows, stage_breakdown).
+    fire_latencies_ms, emitted_rows, stage_breakdown). The stage
+    breakdown embeds the device-path metrics snapshot (compiles, cache
+    hits, transfer bytes, busy/backpressure ratios).
 
     ``device=True`` is the TPU-native ingest: batches are born in HBM
     (DataGenSource(device=True)) and the whole per-batch hot loop is one
@@ -195,7 +225,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
 
     schema = Schema([("auction", np.int64), ("price", np.int64),
                      ("ts", np.int64)])
-    span = _n_panes(n_events) * pane_ms
+    span = _n_panes(n_events, batch) * pane_ms
 
     def gen(idx):
         u = idx.astype(np.uint64)
@@ -204,9 +234,12 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
                 "price": (idx % 997) + 1,
                 "ts": (idx * span) // n_events}
 
+    from flink_tpu.metrics import DEVICE_STATS
+
+    stats_before = DEVICE_STATS.snapshot()
     env = StreamExecutionEnvironment.get_execution_environment()
     env.set_state_backend("tpu")
-    env.config.set(PipelineOptions.BATCH_SIZE, BATCH)
+    env.config.set(PipelineOptions.BATCH_SIZE, batch)
     ws = WatermarkStrategy.for_monotonous_timestamps() \
         .with_timestamp_column("ts")
     sink = _CountSink()
@@ -226,23 +259,46 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
                           defer_overflow=True, async_fire=True)
         .add_sink(sink.fn, "count"))
     t0 = time.perf_counter()
-    env.execute("nexmark-q5", timeout=1800.0)
+    env.execute("nexmark-q5", timeout=1800.0,
+                metrics_registry=metrics_registry)
     wall = time.perf_counter() - t0
     ops = _find_ops(env, DeviceWindowAggOperator)
     lat = [ms for o in ops for ms in o.fire_latencies_ms]
-    return wall, lat, sink.rows, _collect_stages(env)
+    stages = _collect_stages(env)
+    stages.update(_collect_metrics(env, stats_before))
+    return wall, lat, sink.rows, stages
 
 
 def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
                        device: bool = True):
     """Warmup run (compile) + timed run; returns (events/sec, p99 ms,
-    stage breakdown)."""
+    stage breakdown). The timed run's ``recompiles`` must be 0: identical
+    shapes after warmup hit the program caches, never the compiler."""
     _run_q5(n_keys, min(n_events, 4 * BATCH), capacity,
             device=device)                                  # compile warmup
     wall, lat, _rows, stages = _run_q5(n_keys, n_events, capacity,
                                        device=device)
     stages["wall"] = wall
     return n_events / wall, _p99(lat), stages
+
+
+def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
+                n_batches: int = 8, metrics_registry=None) -> dict:
+    """Tiny Q5 acceptance probe (tier-1 safe, no backend subprocess
+    probe): warmup + timed run on whatever backend jax already has;
+    returns the timed run's stage report with the embedded metrics
+    snapshot — ``recompiles`` == 0 is the no-recompile invariant."""
+    n_events = n_batches * batch
+    _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
+            metrics_registry=metrics_registry)              # compile warmup
+    wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
+                                      batch=batch,
+                                      metrics_registry=metrics_registry)
+    stages["wall"] = wall
+    stages["events_per_sec"] = round(n_events / wall, 2)
+    stages["p99_fire_latency_ms"] = round(_p99(lat), 3)
+    stages["emitted_rows"] = rows
+    return stages
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -686,6 +742,15 @@ def _print_breakdown(stages: dict, prefix: str) -> None:
         if k in stages:
             _line(f"{prefix}_stage_{k}_ms", stages[k] * 1e3, "ms",
                   stages[k] / wall if wall else 0.0)
+    # device-path observability snapshot (cumulative; same series as the
+    # prometheus exposition) + this run's recompile delta
+    for k, unit in (("compiles", "programs"), ("compile_cache_hits", ""),
+                    ("recompiles", "programs"), ("compile_ms", "ms"),
+                    ("h2d_bytes", "bytes"), ("d2h_bytes", "bytes"),
+                    ("busy_time_ratio", "ratio"),
+                    ("backpressured_time_ratio", "ratio")):
+        if k in stages:
+            _line(f"{prefix}_{k}", float(stages[k]), unit, 1.0)
 
 
 def _print_tunnel() -> None:
@@ -829,8 +894,23 @@ def bench_topk_ab() -> None:
                   skipped="pallas needs the real TPU backend")
 
 
+def tiny() -> None:
+    """`python bench.py --tiny`: the acceptance probe — one JSON line,
+    the tiny Q5 stage report with the metrics snapshot embedded."""
+    probe = _ensure_backend()
+    _emit_probe(probe)
+    stages = run_tiny_q5()
+    rec = {"metric": "nexmark_q5_tiny_stage_report", "unit": "report"}
+    rec.update({k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in stages.items()})
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
     if "--suite" in sys.argv:
         suite()
+    elif "--tiny" in sys.argv:
+        tiny()
     else:
         main(breakdown="--breakdown" in sys.argv)

@@ -156,10 +156,13 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     """Instantiate channels, gates, writers, chains, and tasks for every
     (vertex, subtask) — the Execution.deploy analog
     (flink-runtime executiongraph/Execution.java:511)."""
-    from ..metrics.core import TaskMetrics
-
     job = LocalJob(job_graph, config)
     job.metrics_registry = metrics_registry
+    if metrics_registry is not None:
+        # process-global compile/transfer accounting surfaces through the
+        # same registry the reporters/REST endpoint scrape
+        from ..metrics.device import bind_device_metrics
+        bind_device_metrics(metrics_registry)
 
     # channels[edge_key][src_sub][dst_sub]; feedback channels are UNBOUNDED:
     # a bounded back edge would wedge the body forever once the head exits

@@ -11,7 +11,12 @@ reduced to the operationally useful slice:
     GET  /jobs/<name>/checkpoints -> completed checkpoint stats
     GET  /jobs/<name>/flamegraph  -> sampled task-thread flamegraph trie
     POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
-    GET  /metrics                 -> prometheus text exposition
+    GET  /metrics                 -> prometheus text exposition (always
+                                     includes the device-path scope:
+                                     compiles / cache hits / transfers)
+    GET  /metrics/snapshot        -> flat JSON snapshot of the registry
+                                     plus the device-path counters (what
+                                     the dashboard's device panel polls)
 """
 
 from __future__ import annotations
@@ -95,6 +100,28 @@ class RestEndpoint:
         from .webui import sample_flamegraph
         return sample_flamegraph(job, duration_s=1.0)
 
+    def _metrics_registry(self):
+        """The bound registry, or a lazily-created one carrying only the
+        process-global device scope — /metrics must expose compile and
+        transfer accounting even for endpoints started without a job
+        registry."""
+        from ..metrics.device import bind_device_metrics
+
+        if self.metrics_registry is None:
+            from ..metrics.core import MetricRegistry
+            self.metrics_registry = MetricRegistry()
+        bind_device_metrics(self.metrics_registry)
+        return self.metrics_registry
+
+    def _metrics_snapshot(self) -> dict:
+        from ..metrics.device import DEVICE_STATS
+
+        snap = {k: v for k, v in self._metrics_registry().snapshot().items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        snap.update({f"device.{k}": v
+                     for k, v in DEVICE_STATS.snapshot().items()})
+        return snap
+
     def _trigger_savepoint(self, name: str) -> tuple[int, dict]:
         coord = self._coordinators.get(name)
         job = self._jobs.get(name)
@@ -147,11 +174,12 @@ class RestEndpoint:
                 elif (len(parts) == 3 and parts[0] == "jobs"
                       and parts[2] == "checkpoints"):
                     self._reply(200, endpoint._checkpoints(parts[1]))
+                elif parts == ["metrics", "snapshot"]:
+                    self._reply(200, endpoint._metrics_snapshot())
                 elif parts == ["metrics"]:
                     from ..metrics.reporters import prometheus_text
-                    reg = endpoint.metrics_registry
-                    text = prometheus_text(reg) if reg else ""
-                    body = text.encode()
+                    body = prometheus_text(
+                        endpoint._metrics_registry()).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
                     self.send_header("Content-Length", str(len(body)))

@@ -93,12 +93,16 @@ def archive_job(archive_dir: str, name: str, job,
     checkpoints = []
     if coordinator is not None:
         checkpoints = list(getattr(coordinator, "stats", []))
+    from ..metrics.device import DEVICE_STATS
     archive = {"name": name,
                "state": "FAILED" if job.failed else "FINISHED",
                "archived_at": time.time(),
                "tasks": len(job.tasks),
                "vertices": vertices,
-               "checkpoints": checkpoints}
+               "checkpoints": checkpoints,
+               # terminal device-path accounting rides the archive so a
+               # history view can still answer "did it recompile?"
+               "device_metrics": DEVICE_STATS.snapshot()}
     path = os.path.join(archive_dir, f"{name}.json")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -223,6 +227,10 @@ background:#e8a33d;cursor:default}
 <section><h2>Checkpoints</h2><table id="ckpts"><thead><tr>
 <th>id</th><th>savepoint</th><th>duration (s)</th><th>tasks</th>
 </tr></thead><tbody></tbody></table></section>
+<section><h2>Device path</h2><table id="dev"><thead><tr>
+<th>compiles</th><th>cache hits</th><th>compile ms</th>
+<th>h2d MB</th><th>d2h MB</th><th>max busy</th><th>max backpressure</th>
+</tr></thead><tbody></tbody></table></section>
 <section><h2>Flamegraph
 <button onclick="flame()">sample 1s</button></h2>
 <div id="flame"></div></section>
@@ -254,6 +262,20 @@ async function refresh(){
       `<tr><td>${c.id}</td><td>${c.savepoint||false}</td>
        <td>${(c.duration_s||0).toFixed(3)}</td><td>${c.tasks||''}</td>
        </tr>`)}
+  const m=await j('/metrics/snapshot');
+  const mb=b=>((b||0)/1048576).toFixed(1);
+  let busy=0,bp=0;
+  for(const k in m){
+    if(k.endsWith('busyTimeRatio'))busy=Math.max(busy,m[k]);
+    if(k.endsWith('backPressuredTimeMsPerSecond'))bp=Math.max(bp,m[k]/1e3);}
+  document.querySelector('#dev tbody').innerHTML=
+    `<tr><td>${m['device.compiles']||0}</td>
+     <td>${m['device.compile_cache_hits']||0}</td>
+     <td>${(m['device.compile_ms']||0).toFixed(0)}</td>
+     <td>${mb(m['device.h2d_bytes'])}</td>
+     <td>${mb(m['device.d2h_bytes'])}</td>
+     <td>${(100*busy).toFixed(0)}%</td>
+     <td>${(100*bp).toFixed(0)}%</td></tr>`;
 }
 function renderFlame(node,total,el,depth){
   if(!total)return;

@@ -66,7 +66,42 @@ def generate_config_docs() -> str:
             out.append(f"| `{opt.key}` | {_fmt_type(opt)} | "
                        f"{_fmt_default(opt)} | {desc} |")
         out.append("")
+    out.append(_REPORTERS_EPILOGUE)
     return "\n".join(out) + "\n"
+
+
+# Hand-written epilogue appended by the generator so the narrative section
+# survives regeneration (the tables above stay code-derived).
+_REPORTERS_EPILOGUE = """\
+## Configuring metric reporters
+
+Reporters poll the job's `MetricRegistry` (reference `ReporterSetup`).
+Select them by name with `metrics.reporters` (comma-separated):
+
+```python
+env.config.set("metrics.reporters", "prometheus,log")
+reg = flink_tpu.metrics.MetricRegistry()
+for rep in flink_tpu.metrics.reporters_from_config(env.config):
+    rep.open(reg)          # PrometheusReporter binds an HTTP port here
+env.execute("job", metrics_registry=reg)
+```
+
+Built-in names:
+
+| Name | Class | Behavior |
+|---|---|---|
+| `prometheus` | `PrometheusReporter` | Serves `GET /metrics` in the text exposition format (pull model); `port=0` picks a free port, read it from `reporter.port`. |
+| `log` | `LoggingReporter` | Dumps a registry snapshot every `metrics.reporter.interval` seconds to its `sink` (default `print`). |
+
+Third-party reporters register under a name with
+`flink_tpu.metrics.register_reporter(name, factory)` and are then
+selectable through `metrics.reporters` like the built-ins.
+
+Latency tracking: set `metrics.latency.interval` > 0 to inject
+`LatencyMarker`s at sources; every operator records source->operator
+latency into its `latency` histogram. The full metric catalog is in
+`docs/OBSERVABILITY.md`.
+"""
 
 
 def main(argv: list[str]) -> int:

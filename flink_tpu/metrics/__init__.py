@@ -1,0 +1,38 @@
+"""Metrics package: counters/gauges/meters/histograms in scoped groups,
+span tracing, and push/pull reporters.
+
+Public API re-exported here so ``from flink_tpu.metrics import Counter,
+Tracer, prometheus_text`` works (the reference exposes flink-metrics-core
+the same way).
+"""
+
+from .core import (
+    Counter, Gauge, Histogram, Meter, MetricGroup, MetricRegistry,
+    TaskMetrics,
+)
+from .device import (
+    DEVICE_STATS, DeviceStats, bind_device_metrics,
+    instrumented_program_cache, pytree_nbytes, set_compile_tracer,
+)
+from .reporters import (
+    LoggingReporter, MetricReporter, PrometheusReporter, prometheus_text,
+    register_reporter, reporters_from_config,
+)
+from .tracing import (
+    InMemoryTraceReporter, Span, SpanBuilder, TraceReporter, Tracer,
+)
+
+__all__ = [
+    # core
+    "Counter", "Gauge", "Meter", "Histogram", "MetricGroup",
+    "MetricRegistry", "TaskMetrics",
+    # tracing
+    "Span", "SpanBuilder", "TraceReporter", "InMemoryTraceReporter",
+    "Tracer",
+    # reporters
+    "MetricReporter", "PrometheusReporter", "LoggingReporter",
+    "prometheus_text", "register_reporter", "reporters_from_config",
+    # device-path accounting
+    "DeviceStats", "DEVICE_STATS", "bind_device_metrics",
+    "instrumented_program_cache", "set_compile_tracer", "pytree_nbytes",
+]

@@ -18,16 +18,24 @@ __all__ = ["Counter", "Gauge", "Meter", "Histogram", "MetricGroup",
 
 
 class Counter:
-    __slots__ = ("_value",)
+    """Thread-safe counter: reporters poll from their own thread while the
+    mailbox loop mutates, and ``_value += n`` is a read-modify-write the
+    GIL does not make atomic (reference SimpleCounter is single-writer;
+    here the lock keeps multi-writer updates lossless too)."""
+
+    __slots__ = ("_value", "_lock")
 
     def __init__(self):
         self._value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self._value += n
+        with self._lock:
+            self._value += n
 
     def dec(self, n: int = 1) -> None:
-        self._value -= n
+        with self._lock:
+            self._value -= n
 
     @property
     def count(self) -> int:
@@ -44,24 +52,30 @@ class Gauge:
 
 
 class Meter:
-    """Rate over a sliding minute (reference MeterView)."""
+    """Rate over a sliding minute (reference MeterView). Locked: the
+    reporter thread iterates the event window while the task thread
+    appends/evicts — unsynchronized, that's a lost update on ``_count``
+    and a RuntimeError-free but torn read of the deque."""
 
     def __init__(self):
         self._events: deque[tuple[float, int]] = deque()
         self._count = 0
+        self._lock = threading.Lock()
 
     def mark(self, n: int = 1) -> None:
-        self._count += n
         now = time.time()
-        self._events.append((now, n))
-        cutoff = now - 60.0
-        while self._events and self._events[0][0] < cutoff:
-            self._events.popleft()
+        with self._lock:
+            self._count += n
+            self._events.append((now, n))
+            cutoff = now - 60.0
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
 
     @property
     def rate(self) -> float:
         now = time.time()
-        recent = sum(n for t, n in self._events if t >= now - 60.0)
+        with self._lock:
+            recent = sum(n for t, n in self._events if t >= now - 60.0)
         return recent / 60.0
 
     @property
@@ -70,18 +84,23 @@ class Meter:
 
 
 class Histogram:
-    """Reservoir histogram with quantiles."""
+    """Reservoir histogram with quantiles. Locked for the same reason as
+    Meter: ``sorted()`` over the deque while the owning thread appends
+    past ``maxlen`` raises 'deque mutated during iteration'."""
 
     def __init__(self, window: int = 1024):
         self._values: deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
 
     def update(self, value: float) -> None:
-        self._values.append(float(value))
+        with self._lock:
+            self._values.append(float(value))
 
     def quantile(self, q: float) -> float:
-        if not self._values:
+        with self._lock:
+            vals = sorted(self._values)
+        if not vals:
             return 0.0
-        vals = sorted(self._values)
         idx = min(int(q * len(vals)), len(vals) - 1)
         return vals[idx]
 
@@ -91,7 +110,14 @@ class Histogram:
 
     @property
     def mean(self) -> float:
-        return sum(self._values) / len(self._values) if self._values else 0.0
+        with self._lock:
+            vals = list(self._values)
+        return sum(vals) / len(vals) if vals else 0.0
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return float(sum(self._values))
 
 
 class MetricGroup:
@@ -171,3 +197,22 @@ class TaskMetrics:
         self.watermark_lag = g.histogram("watermarkLag")
         self.batch_size = g.histogram("batchSize")
         self.group = g
+        self.io_timers = None
+
+    def bind_io_timers(self, timers) -> None:
+        """Expose a task's busy/idle/backpressured accounting as gauges
+        (reference TaskIOMetricGroup busyTimeMsPerSecond family). The
+        timers object outlives the task thread, so reporters keep a
+        stable terminal reading after the job finishes."""
+        self.io_timers = timers
+        g = self.group
+        g.gauge("busyTimeMsPerSecond", lambda: timers.busy_ms_per_s)
+        g.gauge("idleTimeMsPerSecond", lambda: timers.idle_ms_per_s)
+        g.gauge("backPressuredTimeMsPerSecond",
+                lambda: timers.backpressured_ms_per_s)
+        g.gauge("busyTimeRatio", lambda: timers.busy_ratio)
+
+    def operator_group(self, op_key: str) -> MetricGroup:
+        """Per-operator scope under this task (WatermarkGauge / operator
+        latency live here)."""
+        return self.group.group(op_key)

@@ -1,0 +1,215 @@
+"""Device-path accounting: compiles, program-cache hits, transfers.
+
+The compiled fire/step programs are process-global ``lru_cache``-backed
+builders (one executable shared by every operator instance with the same
+shape signature — see runtime/operators/device_window.py), so their
+accounting is process-global too: one ``DeviceStats`` singleton that the
+instrumented builders and the explicit transfer sites feed, readable from
+any ``MetricRegistry`` through ``bind_device_metrics`` (gauges under the
+``device`` scope) and as a flat dict through ``snapshot()`` (what
+bench.py embeds in its stage reports).
+
+Analog of the reference's compile/IO visibility split: Flink counts
+bytes/records per task (TaskIOMetricGroup) and DrJAX-style JAX pipelines
+treat compiled-program reuse as a measured resource — a recompile in the
+hot path costs tens of seconds when the chip sits behind a tunnel, so
+``compiles`` staying flat across identical-shape fires is the invariant
+this module exists to watch.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, Optional
+
+__all__ = ["DeviceStats", "DEVICE_STATS", "instrumented_program_cache",
+           "bind_device_metrics", "set_compile_tracer", "pytree_nbytes"]
+
+
+class DeviceStats:
+    """Process-global compile + transfer counters (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._compiles: dict[str, int] = {}
+        self._cache_hits: dict[str, int] = {}
+        self._compile_ms: dict[str, float] = {}
+        self.h2d_bytes = 0
+        self.h2d_records = 0
+        self.h2d_batches = 0
+        self.d2h_bytes = 0
+        self.d2h_records = 0
+        self.d2h_fires = 0
+        self._tracer = None  # optional Tracer receiving Compile spans
+
+    # -- compile accounting ------------------------------------------------
+    def note_build(self, scope: str) -> None:
+        with self._lock:
+            self._compiles[scope] = self._compiles.get(scope, 0) + 1
+
+    def note_cache_hit(self, scope: str) -> None:
+        with self._lock:
+            self._cache_hits[scope] = self._cache_hits.get(scope, 0) + 1
+
+    def note_compile_done(self, scope: str, ms: float,
+                          start_ms: Optional[int] = None) -> None:
+        with self._lock:
+            self._compile_ms[scope] = self._compile_ms.get(scope, 0.0) + ms
+            tracer = self._tracer
+        if tracer is not None:
+            sb = tracer.span("device", "Compile").set_attribute(
+                "scope", scope).set_attribute("ms", round(ms, 3))
+            if start_ms is not None:
+                sb.set_start_ts(start_ms)
+            sb.finish()
+
+    # -- transfer accounting -----------------------------------------------
+    def note_h2d(self, nbytes: int, records: int = 0) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_records += int(records)
+            self.h2d_batches += 1
+
+    def note_d2h(self, nbytes: int, records: int = 0) -> None:
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+            self.d2h_records += int(records)
+            self.d2h_fires += 1
+
+    # -- views -------------------------------------------------------------
+    @property
+    def compiles(self) -> int:
+        with self._lock:
+            return sum(self._compiles.values())
+
+    @property
+    def compile_cache_hits(self) -> int:
+        with self._lock:
+            return sum(self._cache_hits.values())
+
+    @property
+    def compile_ms(self) -> float:
+        with self._lock:
+            return sum(self._compile_ms.values())
+
+    def snapshot(self) -> dict[str, Any]:
+        """Flat cumulative view — the shape bench.py embeds per stage
+        report and tests compare against the prometheus exposition."""
+        with self._lock:
+            out: dict[str, Any] = {
+                "compiles": sum(self._compiles.values()),
+                "compile_cache_hits": sum(self._cache_hits.values()),
+                "compile_ms": round(sum(self._compile_ms.values()), 3),
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_records": self.h2d_records,
+                "h2d_batches": self.h2d_batches,
+                "d2h_bytes": self.d2h_bytes,
+                "d2h_records": self.d2h_records,
+                "d2h_fires": self.d2h_fires,
+            }
+            for scope, n in sorted(self._compiles.items()):
+                out[f"compiles.{scope}"] = n
+            return out
+
+    def reset(self) -> None:
+        """Test/bench isolation only — counters are otherwise cumulative
+        for the process lifetime (prometheus counter semantics)."""
+        with self._lock:
+            self._compiles.clear()
+            self._cache_hits.clear()
+            self._compile_ms.clear()
+            self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
+            self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
+
+
+DEVICE_STATS = DeviceStats()
+
+
+def set_compile_tracer(tracer) -> None:
+    """Route compile-duration spans into a Tracer (scope 'device',
+    name 'Compile', attributes scope/ms)."""
+    DEVICE_STATS._tracer = tracer
+
+
+def pytree_nbytes(tree) -> int:
+    """Total buffer bytes across a pytree of arrays (host or device)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+class _TimedProgram:
+    """Times the FIRST dispatch of a freshly-built program — jax.jit
+    traces/lowers/compiles synchronously inside that call, so its wall
+    clock IS the compile cost; later calls pay one extra branch."""
+
+    __slots__ = ("_fn", "_scope", "_compiled")
+
+    def __init__(self, fn, scope: str):
+        self._fn = fn
+        self._scope = scope
+        self._compiled = False
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled:
+            return self._fn(*args, **kwargs)
+        start_ms = int(time.time() * 1000)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        self._compiled = True
+        DEVICE_STATS.note_compile_done(
+            self._scope, (time.perf_counter() - t0) * 1e3, start_ms)
+        return out
+
+
+def instrumented_program_cache(scope: str, maxsize: int = 128):
+    """Drop-in replacement for ``functools.lru_cache`` on a compiled-
+    program BUILDER: a cache miss counts one compile (the returned
+    program's first dispatch is timed as its compile span); a hit counts
+    one cache hit. The cached object is shared exactly as before, so
+    donation/in-place semantics of the jitted programs are untouched."""
+
+    def deco(builder: Callable):
+        @functools.lru_cache(maxsize=maxsize)
+        def build(*args, **kwargs):
+            DEVICE_STATS.note_build(scope)
+            return _TimedProgram(builder(*args, **kwargs), scope)
+
+        @functools.wraps(builder)
+        def wrapper(*args, **kwargs):
+            misses = build.cache_info().misses
+            prog = build(*args, **kwargs)
+            if build.cache_info().misses == misses:
+                DEVICE_STATS.note_cache_hit(scope)
+            return prog
+
+        wrapper.cache_clear = build.cache_clear
+        wrapper.cache_info = build.cache_info
+        return wrapper
+
+    return deco
+
+
+def bind_device_metrics(registry) -> None:
+    """Register the global device stats as gauges under the ``device``
+    scope of a MetricRegistry, so prometheus_text / the REST endpoint /
+    reporters expose the same series bench.py reads via snapshot().
+    Idempotent: re-binding overwrites the same scope entries."""
+    g = registry.root().group("device")
+    s = DEVICE_STATS
+    g.gauge("compiles", lambda: s.compiles)
+    g.gauge("compile_cache_hits", lambda: s.compile_cache_hits)
+    g.gauge("compile_ms", lambda: s.compile_ms)
+    g.gauge("h2d_bytes", lambda: s.h2d_bytes)
+    g.gauge("h2d_records", lambda: s.h2d_records)
+    g.gauge("h2d_batches", lambda: s.h2d_batches)
+    g.gauge("d2h_bytes", lambda: s.d2h_bytes)
+    g.gauge("d2h_records", lambda: s.d2h_records)
+    g.gauge("d2h_fires", lambda: s.d2h_fires)

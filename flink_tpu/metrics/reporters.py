@@ -27,35 +27,58 @@ def _prom_name(parts: tuple[str, ...]) -> str:
     return _NAME_RE.sub("_", "_".join(("flink_tpu",) + parts))
 
 
+def _prom_value(v) -> str:
+    """Exposition-format value: finite numbers as-is, non-finite floats
+    spelled the way Prometheus expects (NaN/+Inf/-Inf), anything
+    non-numeric (a gauge fn returning a string/None/array) as NaN rather
+    than corrupting the scrape or raising mid-exposition."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        try:  # numpy scalars and friends quack like floats
+            v = float(v)
+        except (TypeError, ValueError):
+            return "NaN"
+    if isinstance(v, float):
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
+    return repr(v)
+
+
 def prometheus_text(registry: MetricRegistry) -> str:
     """Render the registry in the Prometheus text exposition format
     (reference PrometheusReporter's collector mapping: Counter->counter,
-    Gauge->gauge, Meter->gauge(rate)+counter, Histogram->summary)."""
+    Gauge->gauge, Meter->gauge(rate)+counter, Histogram->summary).
+    Non-numeric gauge values render NaN; a gauge fn that raises is
+    skipped — one bad metric must never take down the whole scrape."""
     lines: list[str] = []
     for scope, m in sorted(registry.all_metrics().items()):
         name = _prom_name(scope)
         if isinstance(m, Counter):
             lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {m.count}")
+            lines.append(f"{name} {_prom_value(m.count)}")
         elif isinstance(m, Gauge):
             try:
                 v = m.value
             except Exception:  # noqa: BLE001 - gauge fn may race shutdown
                 continue
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {v}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_prom_value(v)}")
         elif isinstance(m, Meter):
             lines.append(f"# TYPE {name}_rate gauge")
-            lines.append(f"{name}_rate {m.rate}")
+            lines.append(f"{name}_rate {_prom_value(m.rate)}")
             lines.append(f"# TYPE {name}_total counter")
-            lines.append(f"{name}_total {m.count}")
+            lines.append(f"{name}_total {_prom_value(m.count)}")
         elif isinstance(m, Histogram):
+            # full summary exposition: quantile samples + _sum + _count
             lines.append(f"# TYPE {name} summary")
             for q in (0.5, 0.95, 0.99):
-                lines.append(
-                    f'{name}{{quantile="{q}"}} {m.quantile(q)}')
-            lines.append(f"{name}_count {m.count}")
+                lines.append(f'{name}{{quantile="{q}"}} '
+                             f"{_prom_value(m.quantile(q))}")
+            lines.append(f"{name}_sum {_prom_value(m.sum)}")
+            lines.append(f"{name}_count {_prom_value(m.count)}")
     return "\n".join(lines) + "\n"
 
 

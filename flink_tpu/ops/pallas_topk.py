@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..metrics.device import instrumented_program_cache
+
 __all__ = ["histogram256_pallas", "masked_topk_pallas",
            "pallas_available"]
 
@@ -95,10 +97,22 @@ def masked_topk_pallas(values: jax.Array, valid: jax.Array, k: int,
             or jnp.issubdtype(jnp.asarray(values).dtype, jnp.floating)):
         return masked_topk(values, valid, k, value_bits)
     passes = max(1, -(-value_bits // 8))
-    return _topk_pallas(values, valid, k, passes, interpret)
+    return _topk_program(int(k), int(passes), bool(interpret))(values, valid)
 
 
-@partial(jax.jit, static_argnames=("k", "passes", "interpret"))
+@instrumented_program_cache("ops.pallas_topk", maxsize=32)
+def _topk_program(k: int, passes: int, interpret: bool):
+    """One jitted program per (k, passes, interpret); shapes re-trace
+    inside jax.jit as usual, the builder cache is what the compile
+    accounting watches."""
+
+    @jax.jit
+    def run(values, valid):
+        return _topk_pallas(values, valid, k, passes, interpret)
+
+    return run
+
+
 def _topk_pallas(values, valid, k, passes, interpret):
     n = values.shape[0]
     k = min(k, n)

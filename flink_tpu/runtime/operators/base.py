@@ -87,6 +87,7 @@ class CollectingOutput(Output):
     def __init__(self):
         self.batches: list[RecordBatch] = []
         self.watermarks: list[Watermark] = []
+        self.latency_markers: list[LatencyMarker] = []
         self.side: dict[str, list[RecordBatch]] = {}
 
     def emit(self, batch: RecordBatch) -> None:
@@ -95,6 +96,9 @@ class CollectingOutput(Output):
 
     def emit_watermark(self, watermark: Watermark) -> None:
         self.watermarks.append(watermark)
+
+    def emit_latency_marker(self, marker: LatencyMarker) -> None:
+        self.latency_markers.append(marker)
 
     def emit_side(self, tag: str, batch: RecordBatch) -> None:
         self.side.setdefault(tag, []).append(batch)
@@ -117,11 +121,28 @@ class StreamOperator:
         self.ctx: Optional[OperatorContext] = None
         self.output: Output = None  # type: ignore[assignment]
         self.current_watermark: int = -(1 << 62)
+        self._latency_hist = None
+        self.latency_markers_seen = 0
 
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         self.ctx = ctx
         self.output = output
+        metrics = getattr(ctx, "metrics", None)
+        if metrics is not None and hasattr(metrics, "operator_group"):
+            # per-operator scope (reference AbstractStreamOperator's
+            # WatermarkGauge + latency histogram under the operator group)
+            g = metrics.operator_group(getattr(self, "_op_key", self.name))
+            g.gauge("currentInputWatermark", lambda: self.current_watermark)
+            g.gauge("watermarkLag", self._watermark_lag_ms)
+            self._latency_hist = g.histogram("latency")
+
+    def _watermark_lag_ms(self):
+        """Wall-clock lag behind the operator's event-time watermark; NaN
+        until the first real watermark (MIN would read as astronomic)."""
+        if self.current_watermark <= -(1 << 61):
+            return float("nan")
+        return max(0, int(time.time() * 1000) - self.current_watermark)
 
     def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
         pass
@@ -141,6 +162,13 @@ class StreamOperator:
         self.output.emit_watermark(watermark)
 
     def process_latency_marker(self, marker: LatencyMarker) -> None:
+        # record source->here latency at EVERY hop, then forward (the
+        # reference records into the operator's latency histogram keyed
+        # by source; one histogram per operator suffices here)
+        self.latency_markers_seen += 1
+        if self._latency_hist is not None:
+            self._latency_hist.update(
+                (time.time() - marker.marked_time) * 1e3)
         self.output.emit_latency_marker(marker)
 
     def advance_processing_time(self, now_ms: int) -> None:
@@ -275,6 +303,11 @@ class OperatorChain:
             self.head.process_watermark_n(input_index, watermark)
         else:
             self.head.process_watermark(watermark)
+
+    def process_latency_marker(self, marker: LatencyMarker) -> None:
+        """Route a latency probe through every chained operator (each
+        records its source->operator latency) out to the tail writers."""
+        self.head.process_latency_marker(marker)
 
     def advance_processing_time(self, now_ms: int) -> None:
         for op in self.operators:

@@ -47,7 +47,6 @@ arbitrary NON-late disorder, except the bridge case below):
 
 from __future__ import annotations
 
-import functools
 import time
 from functools import partial
 from typing import Optional, Sequence
@@ -58,6 +57,8 @@ import numpy as np
 
 from ...core.elements import Watermark
 from ...core.records import RecordBatch, Schema
+from ...metrics.device import DEVICE_STATS, instrumented_program_cache, \
+    pytree_nbytes
 from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
     sanitize_keys_device
 from ...ops.segment_ops import pow2_ceil
@@ -71,7 +72,7 @@ _NEG = np.int64(-(1 << 62))
 _POS = np.int64(1 << 62)
 
 
-@functools.lru_cache(maxsize=64)
+@instrumented_program_cache("device_session.step", maxsize=64)
 def _sess_step(fold_sig: tuple, lanes: int, gap: int, dirty_block: int):
     """One fused program per batch. ``fold_sig``: (kind, name, field)."""
     from ...ops.segment_ops import scatter_fold
@@ -251,7 +252,7 @@ def AGG_IDENT_MIN(dtype):
             else jnp.iinfo(dtype).min)
 
 
-@functools.lru_cache(maxsize=64)
+@instrumented_program_cache("device_session.fire", maxsize=64)
 def _sess_fire(agg_sig: tuple, lanes: int, gap: int):
     """Fire scan: compact every open session with end + gap <= boundary
     into [capacity]-bounded buffers and reset its lane. Returns the new
@@ -435,6 +436,10 @@ class DeviceSessionWindowOperator(OneInputOperator):
         sig = self._fold_sig()
         cols = {f: jnp.asarray(pad(np.asarray(batch.column(f))))
                 for _k, _n, f in sig}
+        dkeys = jnp.asarray(pad(keys))
+        dts = jnp.asarray(pad(ts, _NEG))
+        DEVICE_STATS.note_h2d(
+            pytree_nbytes(cols) + dkeys.nbytes + dts.nbytes, n)
         step = _sess_step(sig, self._lanes, self._gap,
                           self._backend.dirty_block_size)
         planes = {n_: self._backend.get_array(n_)
@@ -445,7 +450,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
             self._backend.get_array("__cur_lane__"),
             self._backend.dropped_device, self._late_dev,
             self._backend.dirty_mask,
-            jnp.asarray(pad(keys)), jnp.asarray(pad(ts, _NEG)), cols,
+            dkeys, dts, cols,
             np.int64(n), np.int64(self._fired_boundary))
         self._backend.table = table
         for n_, arr in out.items():
@@ -459,6 +464,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
                 {"k": ekey[:span], "s": estart[:span], "e": eend[:span],
                  "c": ecount[:span],
                  "v": {n_: v[:span] for n_, v in evals.items()}})
+            DEVICE_STATS.note_d2h(pytree_nbytes(host), g)
             chunk = {kk: np.asarray(vv)[:g] for kk, vv in host.items()
                      if kk != "v"}
             for n_, v in host["v"].items():
@@ -526,6 +532,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
             host = jax.device_get(
                 {"k": keys[:span], "s": start[:span], "e": end[:span],
                  "o": {n_: v[:span] for n_, v in outs.items()}})
+            DEVICE_STATS.note_d2h(pytree_nbytes(host), fired_h)
             self._emit(host, fired_h)
             if overflow_h == 0:
                 break

@@ -24,7 +24,6 @@ re-firing or merging windows.
 
 from __future__ import annotations
 
-import functools
 import time
 from collections import deque
 from functools import partial
@@ -37,6 +36,8 @@ import numpy as np
 from ...core.device_records import DeviceRecordBatch
 from ...core.elements import Watermark
 from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
+from ...metrics.device import DEVICE_STATS, instrumented_program_cache, \
+    pytree_nbytes
 from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
     sanitize_keys_device
 from ...state.tpu_backend import TpuKeyedStateBackend
@@ -76,7 +77,7 @@ from ...ops.topk import masked_topk as _masked_topk  # noqa: E402
 # passes (see ops/topk.py)
 
 
-@functools.lru_cache(maxsize=128)
+@instrumented_program_cache("device_window.step")
 def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
                   dirty_block: int, spill_maxp: int = 0):
     """ONE compiled program per batch for the device-resident ingest path:
@@ -171,7 +172,7 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
     return step_fn
 
 
-@functools.lru_cache(maxsize=128)
+@instrumented_program_cache("device_window.native_fold")
 def _native_fold_program(fold_sig: tuple, dirty_block: int):
     """CPU-fallback companion of _step_program: slots come from the native
     host index (backend.native_slots), so this program is only the scatter
@@ -198,7 +199,7 @@ def _native_fold_program(fold_sig: tuple, dirty_block: int):
     return fold
 
 
-@functools.lru_cache(maxsize=128)
+@instrumented_program_cache("device_window.fire")
 def _fire_program(agg_sig: tuple, topk: Optional[int],
                   topk_value_bits: int = 64):
     """ONE compiled program per (aggregate signature, top-k) covering the
@@ -455,7 +456,9 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         schema = Schema([(f.name, f.dtype) for f in batch.schema.fields
                          if f.name in cols])
         ts = batch.timestamps
-        return DeviceRecordBatch(schema, cols, jnp.asarray(ts),
+        dts = jnp.asarray(ts)
+        DEVICE_STATS.note_h2d(pytree_nbytes(cols) + dts.nbytes, batch.n)
+        return DeviceRecordBatch(schema, cols, dts,
                                  int(ts.min()), int(ts.max()))
 
     # -- device-resident ingest (zero-transfer hot path) --------------------
@@ -561,6 +564,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                    self._stage_slots)
         host = jax.device_get({k: v[:span] for k, v in self._stage.items()
                                if k != "count"})
+        DEVICE_STATS.note_d2h(pytree_nbytes(host), take)
         keys = np.asarray(host["keys"])[:take]
         ring = np.asarray(host["ring"])[:take]
         vals = {"__count__": np.ones(take, np.int64)}
@@ -608,6 +612,9 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         vals = tuple(jnp.asarray(_pad(np.asarray(batch.column(f)), 0))
                      for _k, _n, f in sig)
         valid = jnp.asarray(_pad(np.ones(n, bool), False))
+        DEVICE_STATS.note_h2d(
+            pytree_nbytes(vals) + valid.nbytes + flat.nbytes + slots.nbytes,
+            n)
         arrays = {name: backend.get_array(name)
                   for name in self._fire_array_names()}
         prog = _native_fold_program(sig, backend.dirty_block_size)
@@ -674,6 +681,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                 rows.append(col.astype(np.int64))
                 col_meta.append((name, False))
         buf = jnp.asarray(np.stack(rows))          # the ONE upload
+        DEVICE_STATS.note_h2d(buf.nbytes, batch.n)
         slots = self._backend.slots_for_batch_device(buf[0])
         dring = buf[1]
         valid = slots >= 0
@@ -763,6 +771,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         t_drain = time.perf_counter()
         p_end, outs, host_part, t0 = item
         host = jax.device_get(outs)       # ONE transfer for everything
+        d2h_bytes = pytree_nbytes(host)
         if self._topk is not None:
             keys_k, ok, results, dropped, occ = host
             self._backend.apply_health(dropped, occ)
@@ -788,6 +797,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                     kind="stable")[:self._topk]
                 keys = keys[order]
                 results = {n: v[order] for n, v in results.items()}
+        DEVICE_STATS.note_d2h(d2h_bytes, len(keys))
         if len(keys):
             self._emit_rows(p_end, keys, results)
         self._note_latency(t0)

@@ -23,7 +23,9 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from ..core.config import CheckpointingOptions, Configuration, PipelineOptions
+from ..core.config import (
+    CheckpointingOptions, Configuration, MetricOptions, PipelineOptions,
+)
 from ..core.elements import (
     MAX_WATERMARK, CheckpointBarrier, EndOfInput, LatencyMarker, Watermark,
     WatermarkStatus,
@@ -37,7 +39,60 @@ from .operators.base import OperatorChain, OperatorContext, Output
 from .writer import RecordWriter
 
 __all__ = ["StreamTask", "SourceStreamTask", "OneInputStreamTask",
-           "TwoInputStreamTask", "TaskReporter"]
+           "TwoInputStreamTask", "TaskReporter", "TaskIOTimers"]
+
+
+class TaskIOTimers:
+    """Cumulative busy/idle/backpressured wall-clock for one subtask's
+    mailbox loop (reference TaskIOMetricGroup's busyTimeMsPerSecond /
+    idleTimeMsPerSecond / backPressuredTimeMsPerSecond TimerGauges, run-
+    cumulative here instead of last-second-windowed). ``busy_s`` is raw
+    processing time and INCLUDES time blocked inside emits; the writer
+    accounts that blocked time into ``backpressured_s`` separately, so
+    the derived ratios subtract it — busy means 'making progress'."""
+
+    __slots__ = ("busy_s", "idle_s", "backpressured_s",
+                 "_started_at", "_ended_at")
+
+    def __init__(self):
+        self.busy_s = 0.0
+        self.idle_s = 0.0
+        self.backpressured_s = 0.0
+        self._started_at: Optional[float] = None
+        self._ended_at: Optional[float] = None
+
+    def start(self) -> None:
+        if self._started_at is None:
+            self._started_at = time.time()
+
+    def stop(self) -> None:
+        # freeze elapsed at task exit so post-run gauge reads are stable
+        if self._ended_at is None:
+            self._ended_at = time.time()
+
+    @property
+    def elapsed_s(self) -> float:
+        if self._started_at is None:
+            return 0.0
+        return max((self._ended_at or time.time()) - self._started_at,
+                   1e-9)
+
+    @property
+    def busy_ratio(self) -> float:
+        return min(1.0, max(0.0, self.busy_s - self.backpressured_s)
+                   / self.elapsed_s)
+
+    @property
+    def busy_ms_per_s(self) -> float:
+        return self.busy_ratio * 1000.0
+
+    @property
+    def idle_ms_per_s(self) -> float:
+        return min(1.0, self.idle_s / self.elapsed_s) * 1000.0
+
+    @property
+    def backpressured_ms_per_s(self) -> float:
+        return min(1.0, self.backpressured_s / self.elapsed_s) * 1000.0
 
 
 class TaskReporter:
@@ -112,6 +167,10 @@ class StreamTask:
         self._thread: Optional[threading.Thread] = None
         self.operator_state = OperatorStateBackend()
         self._last_proc_time = 0
+        self.io_timers = TaskIOTimers()
+        metrics = getattr(ctx, "metrics", None)
+        if metrics is not None and hasattr(metrics, "bind_io_timers"):
+            metrics.bind_io_timers(self.io_timers)
 
     def all_writers(self):
         yield from self.writers
@@ -142,6 +201,7 @@ class StreamTask:
         # teardown toward a dead peer)
         for w in self.all_writers():
             w.cancel_event = self._cancelled
+            w.io_timers = self.io_timers  # backpressured-time accounting
         self._thread = threading.Thread(target=self._run_safely,
                                         name=self.task_id, daemon=True)
         self._thread.start()
@@ -159,12 +219,15 @@ class StreamTask:
         return self._thread is not None and self._thread.is_alive()
 
     def _run_safely(self) -> None:
+        self.io_timers.start()
         try:
             self.invoke()
             self.reporter.task_finished(self.task_id)
         except BaseException as e:  # noqa: BLE001 - report everything
             if not self._cancelled.is_set():
                 self.reporter.task_failed(self.task_id, e)
+        finally:
+            self.io_timers.stop()
 
     def invoke(self) -> None:
         raise NotImplementedError
@@ -237,6 +300,8 @@ class SourceStreamTask(StreamTask):
     def invoke(self) -> None:
         batch_size = self.config.get(PipelineOptions.BATCH_SIZE)
         wm_interval = self.config.get(PipelineOptions.AUTO_WATERMARK_INTERVAL)
+        latency_interval = self.config.get(MetricOptions.LATENCY_INTERVAL)
+        last_marker_emit = 0.0
         idle_timeout = self.ws.idle_timeout
         if self._restored_reader_state is not None:
             self.reader.restore(self._restored_reader_state)
@@ -281,6 +346,9 @@ class SourceStreamTask(StreamTask):
                         self.alignment_max_overshoot_ms = max(
                             self.alignment_max_overshoot_ms, cur - allowed)
                     time.sleep(0.001)  # paused: mailbox stays live above
+                    # paused-by-group counts as backpressured, not idle:
+                    # downstream consumption is what the pause waits on
+                    self.io_timers.backpressured_s += 0.001
                     # pausing stops READING only — processing-time timers
                     # in the chained operators must keep firing
                     self._advance_processing_time(self.chain)
@@ -289,6 +357,7 @@ class SourceStreamTask(StreamTask):
             batch = self.reader.read_batch(self.current_batch_size)
             read_dt = time.perf_counter() - t0
             self.stage_s["read"] += read_dt
+            self.io_timers.busy_s += read_dt
             if batch is None:  # exhausted (bounded)
                 break
             if batch.n:
@@ -307,6 +376,7 @@ class SourceStreamTask(StreamTask):
                     out.emit(batch)
                 emit_dt = time.perf_counter() - t0
                 self.stage_s["emit"] += emit_dt
+                self.io_timers.busy_s += emit_dt
                 if adaptive:
                     # desired = throughput x target; EMA toward it. At the
                     # fixpoint one batch takes exactly target seconds.
@@ -318,6 +388,7 @@ class SourceStreamTask(StreamTask):
                     self.batch_size_history.append(self.current_batch_size)
             else:
                 time.sleep(0.001)  # unbounded source, nothing available
+                self.io_timers.idle_s += 0.001
                 if (idle_timeout is not None and not idle
                         and time.time() - last_data_time > idle_timeout):
                     idle = True
@@ -332,6 +403,18 @@ class SourceStreamTask(StreamTask):
                         self.chain.process_watermark(Watermark(wm))
                     else:
                         out.emit_watermark(Watermark(wm))
+            if (latency_interval > 0
+                    and now - last_marker_emit >= latency_interval):
+                # end-to-end latency probe (reference latencyTrackingInterval
+                # in StreamSource): rides the chain so every operator
+                # records source->here latency before forwarding
+                last_marker_emit = now
+                marker = LatencyMarker(now, self.task_id,
+                                       self.ctx.subtask_index)
+                if self.chain is not None:
+                    self.chain.process_latency_marker(marker)
+                else:
+                    out.emit_latency_marker(marker)
             self._advance_processing_time(self.chain)
 
         if align_group is not None:
@@ -463,7 +546,9 @@ class TwoInputStreamTask(StreamTask):
                     break
                 self._advance_processing_time(self.chain)
                 time.sleep(0.0005)
+                self.io_timers.idle_s += 0.0005
                 continue
+            t0 = time.perf_counter()
             if ev.kind == "batch":
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.records_in.inc(ev.value.n)
@@ -472,8 +557,11 @@ class TwoInputStreamTask(StreamTask):
                 self.chain.process_watermark_n(gi, ev.value)
             elif ev.kind == "barrier":
                 self._on_barrier(gi, ev.value)
-            elif ev.kind in ("latency", "idle"):
+            elif ev.kind == "latency":
+                self.chain.process_latency_marker(ev.value)
+            elif ev.kind == "idle":
                 self.broadcast_all(ev.value)
+            self.io_timers.busy_s += time.perf_counter() - t0
             self._advance_processing_time(self.chain)
 
         if not self._cancelled.is_set():
@@ -555,7 +643,9 @@ class OneInputStreamTask(StreamTask):
                     break
                 self._advance_processing_time(self.chain)
                 time.sleep(0.0005)
+                self.io_timers.idle_s += 0.0005
                 continue
+            t0 = time.perf_counter()
             if ev.kind == "batch":
                 if self.ctx.metrics is not None:
                     self.ctx.metrics.records_in.inc(ev.value.n)
@@ -565,9 +655,12 @@ class OneInputStreamTask(StreamTask):
             elif ev.kind == "barrier":
                 self._on_barrier(ev.value)
             elif ev.kind == "latency":
-                self.broadcast_all(ev.value)
+                # through the chain, not past it: every operator records
+                # its source->here latency before forwarding downstream
+                self.chain.process_latency_marker(ev.value)
             elif ev.kind == "idle":
                 self.broadcast_all(ev.value)
+            self.io_timers.busy_s += time.perf_counter() - t0
             self._maybe_finish_unaligned()
             self._advance_processing_time(self.chain)
 

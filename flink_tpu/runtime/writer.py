@@ -17,6 +17,7 @@ min-combine correct.
 from __future__ import annotations
 
 import random
+import time
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
@@ -162,13 +163,23 @@ class RecordWriter:
         self.subtask_index = subtask_index
         self._put_timeout = put_timeout
         self.cancel_event = None  # set by the task that owns this writer
+        self.io_timers = None     # set by the task: backpressure accounting
 
     def _put_blocking(self, channel: Channel, element: Any) -> None:
         # Bounded queue full = backpressure; spin with timeout so the task
         # thread stays interruptible (reference: availability future).
-        while not channel.put(element, timeout=self._put_timeout):
-            if self.cancel_event is not None and self.cancel_event.is_set():
-                raise WriterCancelled()
+        # Fast path first: the uncontended put must not pay the clock.
+        if channel.put(element, timeout=0):
+            return
+        t0 = time.perf_counter()
+        try:
+            while not channel.put(element, timeout=self._put_timeout):
+                if (self.cancel_event is not None
+                        and self.cancel_event.is_set()):
+                    raise WriterCancelled()
+        finally:
+            if self.io_timers is not None:
+                self.io_timers.backpressured_s += time.perf_counter() - t0
 
     def emit(self, batch: RecordBatch) -> None:
         if not batch.n:

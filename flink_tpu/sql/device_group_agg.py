@@ -36,7 +36,6 @@ the table.
 
 from __future__ import annotations
 
-import functools
 from functools import partial
 from typing import Optional, Sequence
 
@@ -45,6 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.records import RecordBatch, Schema
+from ..metrics.device import DEVICE_STATS, instrumented_program_cache, \
+    pytree_nbytes
 from ..runtime.operators.base import OneInputOperator, OperatorContext, Output
 from ..state.tpu_backend import TpuKeyedStateBackend
 from . import rowkind as rk
@@ -66,7 +67,7 @@ def combine_key_columns(cols: Sequence[np.ndarray]) -> np.ndarray:
     return out
 
 
-@functools.lru_cache(maxsize=128)
+@instrumented_program_cache("sql.device_group_agg")
 def _gagg_program(fold_sig: tuple, dirty_block: int):
     """ONE compiled program per batch for the whole group-agg hot path.
     ``fold_sig``: tuple of (plane_name, fold_kind, col_index) where
@@ -224,6 +225,7 @@ class DeviceGroupAggOperator(OneInputOperator):
         vals = tuple(jnp.asarray(_padded(
             np.asarray(batch.column(c), np.float64), 0.0))
             for c in col_names)
+        DEVICE_STATS.note_h2d(pytree_nbytes(vals) + P * 8, n)  # vals + sign
         # pads alias the first real key: no new table slots, and the
         # program's n_valid mask keeps them out of every fold
         slots = self._backend.slots_for_batch(_padded(keys, keys[0]))
@@ -246,6 +248,7 @@ class DeviceGroupAggOperator(OneInputOperator):
             "idx": row_idx[:span],
             "prev": {n: v[:span] for n, v in comp_prev.items()},
             "new": {n: v[:span] for n, v in comp_new.items()}})
+        DEVICE_STATS.note_d2h(pytree_nbytes(host), g)
         self._emit_changelog(batch, key_cols, host, g)
 
     # -- emission ----------------------------------------------------------

@@ -24,7 +24,6 @@ the host backend — the graph planner routes accordingly.
 
 from __future__ import annotations
 
-import functools
 import time
 from functools import partial
 from typing import Any, Iterable, Optional
@@ -35,6 +34,7 @@ import numpy as np
 
 from ..core.keygroups import KeyGroupRange, hash_batch, \
     key_groups_for_hash_batch
+from ..metrics.device import instrumented_program_cache
 from ..ops.hash_table import (
     EMPTY_KEY, lookup, lookup_or_insert, make_table, sanitize_keys_device,
 )
@@ -59,7 +59,7 @@ def _sanitize_keys(keys: np.ndarray) -> np.ndarray:
 # first occurrence admits for dedup) via first/last-position scatters.
 # ----------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=128)
+@instrumented_program_cache("state.reset_row")
 def _reset_row_program(sig: tuple):
     """One jitted pane-retirement program per ring-plane signature: zero
     ring row ``row`` of every plane to its aggregate identity in a single

@@ -1,7 +1,12 @@
 """Observability: prometheus reporter, spans, REST endpoint, CLI
-(reference test models: PrometheusReporterTest, rest handler ITCases)."""
+(reference test models: PrometheusReporterTest, rest handler ITCases),
+plus the device-path layer: compile/transfer accounting, mailbox
+busy/idle/backpressure gauges, and bench-report <-> prometheus agreement."""
 
 import json
+import os
+import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -20,10 +25,24 @@ from flink_tpu.metrics.tracing import InMemoryTraceReporter, Tracer
 
 SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))  # bench.py lives at the repo root
+
 
 def _get(url):
     with urllib.request.urlopen(url, timeout=5) as r:
         return r.status, r.read().decode()
+
+
+def _parse_prom(text: str) -> dict:
+    """name (incl. {labels}) -> float for every sample line."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        out[name] = float(val)  # NaN/+Inf/-Inf parse fine
+    return out
 
 
 def test_prometheus_text_rendering():
@@ -155,6 +174,151 @@ def test_rest_endpoint():
     finally:
         endpoint.stop()
         job.wait(60)
+
+
+def test_metrics_package_reexports():
+    """Satellite: the package __init__ re-exports the public API."""
+    from flink_tpu.metrics import (  # noqa: F401
+        DEVICE_STATS, Counter, Gauge, Histogram, LoggingReporter, Meter,
+        MetricGroup, MetricRegistry, PrometheusReporter, Span, TaskMetrics,
+        Tracer, bind_device_metrics, instrumented_program_cache,
+        prometheus_text, register_reporter, reporters_from_config,
+    )
+    assert callable(prometheus_text)
+    assert Counter().count == 0
+
+
+def test_counter_meter_thread_safe():
+    """Reporter thread polls while the mailbox loop mutates: concurrent
+    inc/mark must be lossless (``_value += n`` alone is not atomic)."""
+    from flink_tpu.metrics import Counter, Histogram, Meter
+
+    c, m, h = Counter(), Meter(), Histogram(window=256)
+    N, T = 20_000, 8
+
+    def work():
+        for i in range(N):
+            c.inc()
+            m.mark()
+            h.update(i)
+            if i % 64 == 0:
+                _ = m.rate, h.quantile(0.5), h.mean  # reader interleave
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.count == N * T
+    assert m.count == N * T
+
+
+def test_prometheus_text_hardening():
+    """Non-numeric gauges render NaN (never raise mid-scrape), a raising
+    gauge is skipped, and histogram summaries are valid exposition format
+    (quantile samples + _sum + _count)."""
+    reg = MetricRegistry()
+    g = reg.root().group("h")
+    g.gauge("bad_str", lambda: "not-a-number")
+    g.gauge("none", lambda: None)
+    g.gauge("nanval", lambda: float("nan"))
+    g.gauge("infval", lambda: float("inf"))
+    g.gauge("raises", lambda: 1 / 0)
+    h = g.histogram("lat")
+    h.update(5.0)
+    h.update(7.0)
+    text = prometheus_text(reg)
+    assert "flink_tpu_h_bad_str NaN" in text
+    assert "flink_tpu_h_none NaN" in text
+    assert "flink_tpu_h_nanval NaN" in text
+    assert "flink_tpu_h_infval +Inf" in text
+    assert "raises" not in text
+    assert 'flink_tpu_h_lat{quantile="0.5"} ' in text
+    assert "flink_tpu_h_lat_sum 12.0" in text
+    assert "flink_tpu_h_lat_count 2" in text
+    # every sample line must be "<name or name{labels}> <float>"
+    for ln in text.strip().splitlines():
+        if ln.startswith("#"):
+            continue
+        name, _, val = ln.rpartition(" ")
+        assert name
+        float(val)  # NaN/+Inf parse; anything else would raise
+
+
+def test_compile_cache_accounting():
+    """instrumented_program_cache: a miss counts one compile, a hit one
+    cache hit, and the first dispatch records compile duration."""
+    from flink_tpu.metrics import DEVICE_STATS, instrumented_program_cache
+
+    calls = []
+
+    @instrumented_program_cache("test.scope", maxsize=4)
+    def builder(x: int):
+        calls.append(x)
+        return lambda v: v + x
+
+    before = DEVICE_STATS.snapshot()
+    assert builder(1)(10) == 11
+    assert builder(1)(20) == 21
+    assert builder(2)(10) == 12
+    after = DEVICE_STATS.snapshot()
+    assert calls == [1, 2]
+    assert after["compiles"] - before["compiles"] == 2
+    assert after["compile_cache_hits"] - before["compile_cache_hits"] == 1
+    assert after.get("compiles.test.scope", 0) == 2
+
+
+def test_compile_spans_via_tracer():
+    from flink_tpu.metrics import (
+        InMemoryTraceReporter, Tracer, instrumented_program_cache,
+        set_compile_tracer,
+    )
+
+    mem = InMemoryTraceReporter()
+    set_compile_tracer(Tracer([mem]))
+    try:
+        @instrumented_program_cache("test.span_scope", maxsize=2)
+        def builder(x: int):
+            return lambda v: v * x
+
+        builder(3)(2)
+        spans = [s for s in mem.by_name("Compile")
+                 if s.attributes.get("scope") == "test.span_scope"]
+        assert len(spans) == 1
+    finally:
+        set_compile_tracer(None)
+
+
+def test_tiny_q5_report_agrees_with_prometheus():
+    """Acceptance: the bench stage report embeds compiles /
+    compile_cache_hits / h2d_bytes / d2h_bytes / busy_time_ratio, with no
+    recompiles in the timed run, and prometheus_text exposes the same
+    cumulative series."""
+    import bench
+
+    reg = MetricRegistry()
+    stages = bench.run_tiny_q5(n_keys=500, batch=1 << 11, n_batches=6,
+                               metrics_registry=reg)
+    for k in ("compiles", "compile_cache_hits", "h2d_bytes", "d2h_bytes",
+              "busy_time_ratio"):
+        assert k in stages, k
+    assert stages["compiles"] > 0
+    assert stages["compile_cache_hits"] > 0
+    assert stages["h2d_bytes"] > 0
+    assert stages["d2h_bytes"] > 0
+    assert stages["recompiles"] == 0  # identical shapes after warmup
+    assert 0.0 < stages["busy_time_ratio"] <= 1.0
+    vals = _parse_prom(prometheus_text(reg))
+    assert vals["flink_tpu_device_compiles"] == stages["compiles"]
+    assert (vals["flink_tpu_device_compile_cache_hits"]
+            == stages["compile_cache_hits"])
+    assert vals["flink_tpu_device_h2d_bytes"] == stages["h2d_bytes"]
+    assert vals["flink_tpu_device_d2h_bytes"] == stages["d2h_bytes"]
+    # the aggregate busy ratio lies within the per-task gauge envelope
+    ratios = [v for k, v in vals.items() if k.endswith("busyTimeRatio")]
+    assert ratios
+    assert (min(ratios) - 1e-9 <= stages["busy_time_ratio"]
+            <= max(ratios) + 1e-9)
 
 
 def test_cli_savepoint_info_and_version(tmp_path, capsys):
