@@ -186,6 +186,11 @@ def _collect_metrics(env, before: dict) -> dict:
                                 "compile_ms", "h2d_bytes", "h2d_records",
                                 "d2h_bytes", "d2h_records")}
     out["recompiles"] = snap["compiles"] - before.get("compiles", 0)
+    # degradation-ladder counters (deltas for this run): nonzero only
+    # under injection or a genuinely failing device path
+    for k in ("device_retries_total", "device_degraded_total",
+              "dead_letter_records_total", "injected_faults_total"):
+        out[k] = snap.get(k, 0) - before.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
         t = getattr(task, "io_timers", None)
@@ -202,7 +207,8 @@ def _collect_metrics(env, before: dict) -> dict:
 
 def _run_q5(n_keys: int, n_events: int, capacity: int,
             pane_ms: int = 2000, topk: int = 1000, device: bool = True,
-            batch: int = BATCH, metrics_registry=None):
+            batch: int = BATCH, metrics_registry=None,
+            extra_config: dict = None):
     """One env.execute() of the Q5 pipeline; returns (wall_seconds,
     fire_latencies_ms, emitted_rows, stage_breakdown). The stage
     breakdown embeds the device-path metrics snapshot (compiles, cache
@@ -240,6 +246,8 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     env = StreamExecutionEnvironment.get_execution_environment()
     env.set_state_backend("tpu")
     env.config.set(PipelineOptions.BATCH_SIZE, batch)
+    for k, v in (extra_config or {}).items():
+        env.config.set(k, v)
     ws = WatermarkStrategy.for_monotonous_timestamps() \
         .with_timestamp_column("ts")
     sink = _CountSink()
@@ -283,22 +291,54 @@ def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
 
 
 def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
-                n_batches: int = 8, metrics_registry=None) -> dict:
+                n_batches: int = 8, metrics_registry=None,
+                chaos_seed=None) -> dict:
     """Tiny Q5 acceptance probe (tier-1 safe, no backend subprocess
     probe): warmup + timed run on whatever backend jax already has;
     returns the timed run's stage report with the embedded metrics
-    snapshot — ``recompiles`` == 0 is the no-recompile invariant."""
+    snapshot — ``recompiles`` == 0 is the no-recompile invariant.
+
+    ``chaos_seed``: run the timed pass with deterministic fault injection
+    armed at every device-path site (transient/bounded schedules — see
+    CHAOS_SPEC); the report then embeds the retry/degradation/dead-letter
+    counters the run produced. The recompile invariant is NOT asserted
+    under chaos (retried compiles legitimately recount)."""
     n_events = n_batches * batch
+    extra = None
+    if chaos_seed is not None:
+        extra = {"faults.enabled": True, "faults.seed": int(chaos_seed),
+                 "faults.spec": CHAOS_SPEC,
+                 "state.backend.tpu.host-index": False}
+        from flink_tpu.runtime.faults import FAULTS
+        FAULTS.reset()  # arm fresh: visit counters start at zero
     _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
             metrics_registry=metrics_registry)              # compile warmup
     wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
                                       batch=batch,
-                                      metrics_registry=metrics_registry)
+                                      metrics_registry=metrics_registry,
+                                      extra_config=extra)
     stages["wall"] = wall
     stages["events_per_sec"] = round(n_events / wall, 2)
     stages["p99_fire_latency_ms"] = round(_p99(lat), 3)
     stages["emitted_rows"] = rows
+    if chaos_seed is not None:
+        from flink_tpu.runtime.faults import FAULTS
+        stages["chaos_seed"] = int(chaos_seed)
+        stages["chaos_trips"] = FAULTS.snapshot()["trips"]
+        FAULTS.reset()
     return stages
+
+
+#: The --chaos schedule: every device-path site armed with a bounded or
+#: probabilistic transient schedule, so the run completes while still
+#: exercising retry, injected backpressure, quarantine-free recovery, and
+#: the failed-checkpoint-write tolerance. (Persistent-degradation trials
+#: live in tests/test_chaos.py where results are asserted exactly.)
+CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
+              "transfer.h2d=p0.05,transfer.d2h=p0.05,"
+              "channel.send=once@3,channel.backpressure=every@17,"
+              "checkpoint.write=once@1,sink.invoke=once@2,"
+              "rpc.heartbeat=every@5")
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -907,10 +947,30 @@ def tiny() -> None:
     sys.stdout.flush()
 
 
+def chaos(seed: int) -> None:
+    """`python bench.py --chaos SEED`: the tiny Q5 stage with
+    deterministic fault injection armed at every site (CHAOS_SPEC, seeded
+    by SEED); one JSON line embedding the run's retry / degradation /
+    dead-letter / injected-fault counters alongside throughput. Same
+    seed => byte-identical trip schedule."""
+    probe = _ensure_backend()
+    _emit_probe(probe)
+    stages = run_tiny_q5(chaos_seed=seed)
+    rec = {"metric": "nexmark_q5_tiny_chaos_report", "unit": "report",
+           "chaos_spec": CHAOS_SPEC}
+    rec.update({k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in stages.items()})
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
     if "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:
         tiny()
+    elif "--chaos" in sys.argv:
+        i = sys.argv.index("--chaos")
+        chaos(int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 0)
     else:
         main(breakdown="--breakdown" in sys.argv)

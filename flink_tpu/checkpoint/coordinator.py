@@ -210,7 +210,22 @@ class CheckpointCoordinator:
             checkpoint_id=p.checkpoint_id, timestamp=p.started,
             task_snapshots=dict(p.acks), is_savepoint=p.is_savepoint,
             vertex_parallelism=vertex_par, vertex_uids=vertex_uids)
-        cp = self.storage.store(cp)
+        try:
+            cp = self.storage.store(cp)
+        except Exception as e:  # noqa: BLE001 - storage outage/injection
+            # a failed checkpoint WRITE must not fail the job (reference:
+            # tolerable checkpoint failures): abort this checkpoint, keep
+            # running on the previous completed one, record the event
+            with self._lock:
+                self.stats.append({
+                    "id": p.checkpoint_id, "savepoint": p.is_savepoint,
+                    "duration_s": time.time() - p.started,
+                    "tasks": len(p.acks), "failed": True,
+                    "error": f"{type(e).__name__}: {e}"})
+            p.declined = True
+            p.done.set()
+            self._notify_aborted(p.checkpoint_id)
+            return
         duration = time.time() - p.started
         if self.tracer is not None:
             (self.tracer.span("checkpoint-coordinator", "Checkpoint")

@@ -62,11 +62,21 @@ class CheckpointStorage:
         raise NotImplementedError
 
 
+def _fire_checkpoint_write() -> None:
+    """Fault site checkpoint.write (docs/ROBUSTNESS.md): a trip fails the
+    store like a full/unreachable checkpoint volume would. The
+    coordinators treat any store failure as an aborted checkpoint — the
+    job keeps running on its previous completed checkpoint."""
+    from ..runtime.faults import FAULTS
+    FAULTS.fire("checkpoint.write")
+
+
 class MemoryCheckpointStorage(CheckpointStorage):
     def __init__(self):
         self._store: dict[int, CompletedCheckpoint] = {}
 
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
+        _fire_checkpoint_write()
         self._store[checkpoint.checkpoint_id] = checkpoint
         return checkpoint
 
@@ -334,6 +344,7 @@ class FsCheckpointStorage(CheckpointStorage):
 
     # -- storage API ---------------------------------------------------
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
+        _fire_checkpoint_write()
         d = self._path(checkpoint)
         os.makedirs(d, exist_ok=True)
         # set the path BEFORE pickling so a checkpoint load()ed from disk

@@ -146,7 +146,17 @@ class _Coordinator:
         self.resources = SlotManager()
         self._all_done_sent = False
         self._restart_inflight = False
-        self._hb_timeout = 5.0  # refined when monitor() starts
+        # derived from the configured heartbeat interval AT CONSTRUCTION
+        # (same formula run() passes to monitor()): a worker dying before
+        # monitor() starts is now detected with the configured window, not
+        # a hard-coded 5 s that a short interval was supposed to shrink
+        self._hb_timeout = (
+            3 * config.get(RuntimeOptions.HEARTBEAT_INTERVAL) + 2.0)
+        self._last_restart_ts = 0.0
+        # bounded failure history (FailureHandlingResult analog): worker
+        # failure reports and restart decisions, oldest evicted first
+        from collections import deque
+        self.failure_history: deque = deque(maxlen=64)
         threading.Thread(target=self._accept_loop, name="coord-accept",
                          daemon=True).start()
 
@@ -214,6 +224,13 @@ class _Coordinator:
                     with self._lock:
                         stale = (msg.get("epoch", 0) < self.epoch
                                  or self.failed is not None)
+                        if not stale:
+                            self.failure_history.append({
+                                "timestamp": time.time(),
+                                "host": msg["host_id"],
+                                "epoch": msg.get("epoch", 0),
+                                "kind": "task-failure",
+                                "error": msg.get("error", "unknown")})
                     if stale:
                         pass  # a previous attempt's report, already handled
                     elif not self._maybe_restart(
@@ -293,7 +310,18 @@ class _Coordinator:
                     vertex_uids=dict(self._vertex_uids))
                 del self._pending_hosts[cid]
         if complete is not None:
-            complete = self.storage.store(complete)
+            try:
+                complete = self.storage.store(complete)
+            except Exception as e:  # noqa: BLE001 - storage outage
+                # tolerate the failed WRITE: the job runs on against its
+                # previous completed checkpoint (reference tolerable
+                # checkpoint failures); record the event and move on
+                with self._lock:
+                    self.failure_history.append({
+                        "timestamp": time.time(), "checkpoint": cid,
+                        "kind": "checkpoint-write-failure",
+                        "error": f"{type(e).__name__}: {e}"})
+                return
             with self._lock:
                 self.completed.append(complete)
             self.broadcast({"type": "checkpoint_complete",
@@ -349,6 +377,10 @@ class _Coordinator:
                 return
             self.epoch += 1
             self.restarts += 1
+            self._last_restart_ts = now
+            self.failure_history.append({
+                "timestamp": now, "kind": "restart", "epoch": self.epoch,
+                "reason": reason, "live_hosts": list(live)})
             epoch = self.epoch
             self._expected = set(live)
             self._all_done_sent = False
@@ -397,6 +429,13 @@ class _Coordinator:
                 dead = [w.host_id for w in self._workers.values()
                         if not w.finished
                         and now - w.last_heartbeat > heartbeat_timeout]
+            if (not dead and self.restarts and self._last_restart_ts
+                    and now - self._last_restart_ts > 2 * heartbeat_timeout):
+                # a healthy stretch after a restart resets the restart
+                # strategy's escalation (backoff returns to initial) —
+                # without this, one bad hour a week escalates forever
+                self._strategy.notify_recovered()
+                self._last_restart_ts = 0.0
             if dead and self.failed is None:
                 if not self._maybe_restart(
                         dead, f"worker(s) {dead} missed heartbeats"):
@@ -484,6 +523,8 @@ class DistributedHost:
         (resource_manager.build_schedule — a 2-slot host takes twice the
         subtasks of a 1-slot host)."""
         jg, config = self.jg, self.config
+        from ..runtime.faults import FAULTS
+        FAULTS.configure(config)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "
@@ -810,8 +851,16 @@ class DistributedHost:
             pass
 
     def _heartbeat_loop(self) -> None:
+        from ..runtime.faults import FAULTS
         interval = self.config.get(RuntimeOptions.HEARTBEAT_INTERVAL)
         while not self._cancelled.is_set():
+            if FAULTS.enabled and FAULTS.check("rpc.heartbeat"):
+                # drop-style fault site: this beat is lost on the wire;
+                # enough consecutive drops and the coordinator declares
+                # the worker dead and redeploys — the chaos path for the
+                # heartbeat-timeout failover
+                time.sleep(interval)
+                continue
             job = self.job
             minima = (job.watermark_alignment.local_minima()
                       if job is not None else {})

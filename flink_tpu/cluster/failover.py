@@ -76,7 +76,13 @@ class ExponentialDelayRestartStrategy(RestartStrategy):
         self._last_failure = now
 
     def notify_recovered(self) -> None:
+        # reset the escalation AND the failure clock: without clearing
+        # _last_failure, the first failure AFTER a healthy stretch still
+        # lands inside the old reset_after window and escalates straight
+        # to initial*multiplier (reference ExponentialDelayRestartBackoff-
+        # TimeStrategy resets its whole state on a stable run)
         self._current = self.initial
+        self._last_failure = 0.0
 
     def can_restart(self) -> bool:
         return True
@@ -95,12 +101,18 @@ class FailureRateRestartStrategy(RestartStrategy):
         self._failures: list[float] = []
 
     def notify_failure(self) -> None:
-        now = time.time()
-        self._failures.append(now)
-        self._failures = [t for t in self._failures
-                          if t >= now - self.interval]
+        self._failures.append(time.time())
+        self._prune()
+
+    def _prune(self) -> None:
+        cutoff = time.time() - self.interval
+        self._failures = [t for t in self._failures if t >= cutoff]
 
     def can_restart(self) -> bool:
+        # prune HERE too: old entries must age out even when no new
+        # failure arrives, otherwise a burst permanently poisons the
+        # window and the strategy never allows another restart
+        self._prune()
         return len(self._failures) <= self.max_failures
 
     def backoff_seconds(self) -> float:

@@ -56,6 +56,13 @@ class LocalJob(TaskReporter):
         self.kv_registry = KvStateRegistry()
         from ..runtime.alignment import WatermarkAlignmentCoordinator
         self.watermark_alignment = WatermarkAlignmentCoordinator()
+        # bounded per-job failure history (the FailureHandlingResult
+        # analog, reference ExceptionHistoryEntry): every task failure,
+        # degradation, and restart decision lands here; REST exposes it
+        # at /jobs/<name>/exceptions. The supervisor shares ONE deque
+        # across restart attempts so history survives redeploys.
+        from collections import deque
+        self.failure_history: deque = deque(maxlen=64)
         # per-attempt Execution records (reference ExecutionGraph's
         # Execution/ExecutionAttemptID): every deployment of a task id
         # appends one attempt with its state transitions
@@ -106,6 +113,10 @@ class LocalJob(TaskReporter):
         with self._lock:
             self._exec_set(task_id, "FAILED", failure=repr(error))
             self._failed.append((task_id, error))
+            self.failure_history.append({
+                "timestamp": time.time(), "task": task_id,
+                "kind": "task-failure",
+                "error": f"{type(error).__name__}: {error}"})
             self._done.set()
 
     # -- control -----------------------------------------------------------
@@ -158,6 +169,11 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     (flink-runtime executiongraph/Execution.java:511)."""
     job = LocalJob(job_graph, config)
     job.metrics_registry = metrics_registry
+    # arm (or disarm) the process-global fault injector from THIS job's
+    # config — idempotent on an unchanged spec, so failover redeploys
+    # keep their visit counters (a once@N fault must not re-arm)
+    from ..runtime.faults import FAULTS
+    FAULTS.configure(config)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape

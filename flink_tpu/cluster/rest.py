@@ -9,6 +9,10 @@ reduced to the operationally useful slice:
     GET  /jobs                    -> running job overview
     GET  /jobs/<name>             -> vertices, parallelism, task states
     GET  /jobs/<name>/checkpoints -> completed checkpoint stats
+    GET  /jobs/<name>/exceptions  -> bounded failure history (task
+                                     failures, restarts, failed
+                                     checkpoint writes — the reference's
+                                     JobExceptionsHandler analog)
     GET  /jobs/<name>/flamegraph  -> sampled task-thread flamegraph trie
     POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
     GET  /metrics                 -> prometheus text exposition (always
@@ -93,6 +97,25 @@ class RestEndpoint:
                  "tasks": stats.get(c.checkpoint_id, {}).get("tasks")}
                 for c in getattr(coord, "_completed", [])]
 
+    def _exceptions(self, name: str) -> Optional[dict]:
+        """Bounded failure history (the reference's JobExceptionsHandler /
+        exception-history endpoint): task failures, restart decisions,
+        degradations — newest first — plus any failed checkpoint writes
+        from the coordinator's stats."""
+        job = self._jobs.get(name)
+        if job is None:
+            return None
+        entries = list(getattr(job, "failure_history", ()) or ())
+        coord = self._coordinators.get(name)
+        for s in getattr(coord, "stats", []) or []:
+            if s.get("failed"):
+                entries.append({"timestamp": None, "kind":
+                                "checkpoint-write-failure",
+                                "checkpoint": s.get("id"),
+                                "error": s.get("error")})
+        entries.sort(key=lambda e: e.get("timestamp") or 0, reverse=True)
+        return {"name": name, "entries": entries}
+
     def _flamegraph(self, name: str) -> Optional[dict]:
         job = self._jobs.get(name)
         if job is None:
@@ -174,6 +197,11 @@ class RestEndpoint:
                 elif (len(parts) == 3 and parts[0] == "jobs"
                       and parts[2] == "checkpoints"):
                     self._reply(200, endpoint._checkpoints(parts[1]))
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "exceptions"):
+                    exc = endpoint._exceptions(parts[1])
+                    self._reply(200 if exc else 404,
+                                exc or {"error": "no such job"})
                 elif parts == ["metrics", "snapshot"]:
                     self._reply(200, endpoint._metrics_snapshot())
                 elif parts == ["metrics"]:

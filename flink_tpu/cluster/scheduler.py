@@ -49,6 +49,11 @@ class JobSupervisor:
         self._latest: Optional[CompletedCheckpoint] = None
         self._rescaling = False  # guards the cancel->redeploy swap window
         self.failures: list[tuple[int, str]] = []  # (attempt, error message)
+        # one bounded history shared across every attempt's LocalJob (the
+        # FailureHandlingResult analog): task failures append from the
+        # reporter, restart decisions append here
+        from collections import deque
+        self.failure_history: deque = deque(maxlen=64)
 
     # -- lifecycle ---------------------------------------------------------
     def _deploy(self, restore: Optional[CompletedCheckpoint]) -> LocalJob:
@@ -57,6 +62,7 @@ class JobSupervisor:
         job = deploy_local(self.job_graph, self.config,
                            restored_state=restored_state,
                            metrics_registry=self.metrics_registry)
+        job.failure_history = self.failure_history  # survives redeploys
         coordinator = CheckpointCoordinator(job, self.config)
         if self._latest is not None:
             # keep checkpoint ids monotonically increasing across restarts
@@ -127,9 +133,17 @@ class JobSupervisor:
                 self.failures.append((self.attempt, str(e)))
                 self.restart_strategy.notify_failure()
                 if not self.restart_strategy.can_restart():
+                    self.failure_history.append({
+                        "timestamp": time.time(), "attempt": self.attempt,
+                        "kind": "terminal-failure", "error": str(e)})
                     raise RuntimeError(
                         f"Job failed terminally after {self.attempt} "
                         f"attempts: {e}") from e
+                self.failure_history.append({
+                    "timestamp": time.time(), "attempt": self.attempt,
+                    "kind": "restart", "error": str(e),
+                    "restored_checkpoint": (self._latest.checkpoint_id
+                                            if self._latest else None)})
                 job.cancel()
                 time.sleep(self.restart_strategy.backoff_seconds())
                 restore = self._latest
@@ -156,6 +170,10 @@ class JobSupervisor:
         if not self.restart_strategy.can_restart():
             return False
         self.failures.append((self.attempt, str(failed[0][1])))
+        self.failure_history.append({
+            "timestamp": time.time(), "attempt": self.attempt,
+            "kind": "region-restart", "error": str(failed[0][1]),
+            "vertices": sorted(vids)})
         latest = self.coordinator.latest_checkpoint()
         restored = {}
         if latest is not None:

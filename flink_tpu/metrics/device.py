@@ -42,6 +42,13 @@ class DeviceStats:
         self.d2h_bytes = 0
         self.d2h_records = 0
         self.d2h_fires = 0
+        # robustness accounting (PR 2): retries/degradations per scope,
+        # dead-letter quarantines, and injected-fault trips per site
+        self._retries: dict[str, int] = {}
+        self._degraded: dict[str, int] = {}
+        self._injected: dict[str, int] = {}
+        self.dead_letter_records = 0
+        self.dead_letter_batches = 0
         self._tracer = None  # optional Tracer receiving Compile spans
 
     # -- compile accounting ------------------------------------------------
@@ -78,6 +85,39 @@ class DeviceStats:
             self.d2h_records += int(records)
             self.d2h_fires += 1
 
+    # -- robustness accounting ---------------------------------------------
+    def note_retry(self, scope: str, n: int = 1) -> None:
+        with self._lock:
+            self._retries[scope] = self._retries.get(scope, 0) + n
+
+    def note_degraded(self, scope: str) -> None:
+        with self._lock:
+            self._degraded[scope] = self._degraded.get(scope, 0) + 1
+
+    def note_injected(self, site: str) -> None:
+        with self._lock:
+            self._injected[site] = self._injected.get(site, 0) + 1
+
+    def note_dead_letter(self, records: int, batches: int = 1) -> None:
+        with self._lock:
+            self.dead_letter_records += int(records)
+            self.dead_letter_batches += int(batches)
+
+    @property
+    def retries(self) -> int:
+        with self._lock:
+            return sum(self._retries.values())
+
+    @property
+    def degraded(self) -> int:
+        with self._lock:
+            return sum(self._degraded.values())
+
+    @property
+    def injected_faults(self) -> int:
+        with self._lock:
+            return sum(self._injected.values())
+
     # -- views -------------------------------------------------------------
     @property
     def compiles(self) -> int:
@@ -108,9 +148,20 @@ class DeviceStats:
                 "d2h_bytes": self.d2h_bytes,
                 "d2h_records": self.d2h_records,
                 "d2h_fires": self.d2h_fires,
+                "device_retries_total": sum(self._retries.values()),
+                "device_degraded_total": sum(self._degraded.values()),
+                "dead_letter_records_total": self.dead_letter_records,
+                "dead_letter_batches_total": self.dead_letter_batches,
+                "injected_faults_total": sum(self._injected.values()),
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
+            for scope, n in sorted(self._retries.items()):
+                out[f"retries.{scope}"] = n
+            for scope, n in sorted(self._degraded.items()):
+                out[f"degraded.{scope}"] = n
+            for site, n in sorted(self._injected.items()):
+                out[f"injected.{site}"] = n
             return out
 
     def reset(self) -> None:
@@ -120,6 +171,10 @@ class DeviceStats:
             self._compiles.clear()
             self._cache_hits.clear()
             self._compile_ms.clear()
+            self._retries.clear()
+            self._degraded.clear()
+            self._injected.clear()
+            self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
 
@@ -179,6 +234,13 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
     def deco(builder: Callable):
         @functools.lru_cache(maxsize=maxsize)
         def build(*args, **kwargs):
+            # the device.compile fault site covers EVERY instrumented
+            # builder (device_window/device_session/device_group_agg/
+            # pallas_topk/tpu_backend) at the one place a compile is
+            # decided; transient trips retry, persistent ones surface to
+            # the caller's DeviceGuard / failover
+            from ..runtime.faults import fire_with_retries
+            fire_with_retries("device.compile", scope=scope)
             DEVICE_STATS.note_build(scope)
             return _TimedProgram(builder(*args, **kwargs), scope)
 
@@ -213,3 +275,9 @@ def bind_device_metrics(registry) -> None:
     g.gauge("d2h_bytes", lambda: s.d2h_bytes)
     g.gauge("d2h_records", lambda: s.d2h_records)
     g.gauge("d2h_fires", lambda: s.d2h_fires)
+    # degradation-ladder counters (prometheus: flink_tpu_device_*)
+    g.gauge("retries_total", lambda: s.retries)
+    g.gauge("degraded_total", lambda: s.degraded)
+    g.gauge("dead_letter_records_total", lambda: s.dead_letter_records)
+    g.gauge("dead_letter_batches_total", lambda: s.dead_letter_batches)
+    g.gauge("injected_faults_total", lambda: s.injected_faults)

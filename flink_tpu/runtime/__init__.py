@@ -1,4 +1,7 @@
 """Runtime: tasks, channels, operators, timers, harness (SURVEY.md §2.5/L4)."""
 
+from .faults import (  # noqa: F401
+    DeviceGuard, DeviceSegmentError, FAULTS, FaultInjector, InjectedFault,
+)
 from .harness import OneInputOperatorTestHarness  # noqa: F401
 from .timers import InternalTimerService, Timer  # noqa: F401

@@ -23,6 +23,7 @@ from ..core.elements import (
     CheckpointBarrier, EndOfInput, LatencyMarker, Watermark, WatermarkStatus,
 )
 from ..core.records import MIN_TIMESTAMP, RecordBatch
+from .faults import FAULTS
 
 __all__ = ["Channel", "LocalChannel", "InputGate", "IterationGate",
            "GateEvent"]
@@ -48,6 +49,12 @@ class LocalChannel(Channel):
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
 
     def put(self, element: Any, timeout: Optional[float] = None) -> bool:
+        if FAULTS.enabled and FAULTS.check("channel.backpressure"):
+            # drop-style site: report "queue full" once — the writer's
+            # bounded-queue spin treats it exactly like real credit
+            # exhaustion and retries, so chaos runs exercise the
+            # backpressure path deterministically without losing data
+            return False
         try:
             self._q.put(element, timeout=timeout)
             return True

@@ -438,8 +438,11 @@ class DeviceSessionWindowOperator(OneInputOperator):
                 for _k, _n, f in sig}
         dkeys = jnp.asarray(pad(keys))
         dts = jnp.asarray(pad(ts, _NEG))
+        from ..faults import fire_with_retries
+        fire_with_retries("transfer.h2d", scope="device_session")
         DEVICE_STATS.note_h2d(
             pytree_nbytes(cols) + dkeys.nbytes + dts.nbytes, n)
+        fire_with_retries("device.execute", scope="device_session")
         step = _sess_step(sig, self._lanes, self._gap,
                           self._backend.dirty_block_size)
         planes = {n_: self._backend.get_array(n_)
@@ -459,6 +462,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
         self._backend._dropped = dropped
         g = int(jax.device_get(n_emit))
         if g:
+            fire_with_retries("transfer.d2h", scope="device_session")
             span = min(pow2_ceil(g), P)
             host = jax.device_get(
                 {"k": ekey[:span], "s": estart[:span], "e": eend[:span],
@@ -516,6 +520,8 @@ class DeviceSessionWindowOperator(OneInputOperator):
         if not self._registered:
             return
         t0 = time.perf_counter()
+        from ..faults import fire_with_retries
+        fire_with_retries("device.execute", scope="device_session")
         fire = _sess_fire(self._agg_sig(), self._lanes, self._gap)
         while True:
             planes = {n_: self._backend.get_array(n_)

@@ -38,6 +38,8 @@ from ...core.elements import Watermark
 from ...core.records import MIN_TIMESTAMP, RecordBatch, Schema
 from ...metrics.device import DEVICE_STATS, instrumented_program_cache, \
     pytree_nbytes
+from ..faults import DeviceGuard, DeviceSegmentError, FAULTS, \
+    fire_with_retries
 from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
     sanitize_keys_device
 from ...state.tpu_backend import TpuKeyedStateBackend
@@ -345,6 +347,14 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         self._fire_fn = None
         self._out_schema: Optional[Schema] = None
         self._late_dev = None  # device late-drop counter (device ingest)
+        # degradation ladder (docs/ROBUSTNESS.md): once a persistent
+        # compiled-segment failure evacuates state to host, this operator
+        # is pinned to the CPU-fallback ingest path for its lifetime
+        self._degraded = False
+        self._degrade_enabled = True
+        self._validate_batches = False
+        self._guard: Optional[DeviceGuard] = None
+        self.quarantined_batches = 0
         # wall-clock per hot-path stage (bench breakdown): ingest = pack +
         # upload + fold dispatch, fire = fire dispatch, drain = result
         # materialization + emit
@@ -354,13 +364,19 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         super().setup(ctx, output)
-        from ...core.config import StateOptions
+        from ...core.config import FaultOptions, StateOptions
         budget = self._hbm_budget or ctx.config.get(
             StateOptions.TPU_HBM_BUDGET)
+        self._guard = DeviceGuard("device_window", ctx.config)
+        self._degrade_enabled = bool(
+            ctx.config.get(FaultOptions.DEGRADATION))
+        self._validate_batches = bool(
+            ctx.config.get(FaultOptions.VALIDATE_BATCHES))
         self._backend = TpuKeyedStateBackend(
             ctx.key_group_range, ctx.max_parallelism,
             capacity=self._capacity, defer_overflow=self._defer,
-            hbm_budget_slots=budget)
+            hbm_budget_slots=budget,
+            host_index=bool(ctx.config.get(StateOptions.TPU_HOST_INDEX)))
         # count-plane width follows the declared result bound: a COUNT
         # aggregate with value_bits <= 31 promises every per-window count
         # fits int32, which halves the fold scatter + fire merge traffic
@@ -419,8 +435,21 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                     f"{self._key_column!r} is {key_dtype} — use the hashmap "
                     "state backend for float/string keys")
             self._register_aggs(batch.schema)
+        if self._validate_batches:
+            batch = self._screen_nonfinite(batch)
+            if batch.n == 0:
+                return
         t0 = time.perf_counter()
-        if self._backend.host_index_active:
+        if self._degraded and not self._backend.host_index_active:
+            # degradation ladder, last rung: state lives host-side, slot
+            # resolution through the synchronous backend path; device
+            # batches are viewed as host columns (on the CPU backend a
+            # view, not a transfer)
+            hb = self._host_view(batch)
+            keys = np.asarray(hb.column(self._key_column)).astype(
+                np.int64, copy=False)
+            self._ingest(hb, keys)
+        elif self._backend.host_index_active:
             # CPU fallback: slot resolution through the native host index
             # (the "device" IS the host — see TpuKeyedStateBackend
             # .native_slots); pane bookkeeping + late filter run in the
@@ -457,9 +486,89 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                          if f.name in cols])
         ts = batch.timestamps
         dts = jnp.asarray(ts)
+        fire_with_retries("transfer.h2d", scope="device_window")
         DEVICE_STATS.note_h2d(pytree_nbytes(cols) + dts.nbytes, batch.n)
         return DeviceRecordBatch(schema, cols, dts,
                                  int(ts.min()), int(ts.max()))
+
+    # -- degradation ladder / dead-letter quarantine ------------------------
+    def _screen_nonfinite(self, batch: RecordBatch) -> RecordBatch:
+        """faults.validate-batches: rows carrying NaN/Inf in any
+        aggregated float column are quarantined to the dead-letter output
+        BEFORE folding — a NaN folded into a sum/avg plane poisons every
+        later window of that key."""
+        bad = None
+        for a in self._aggs:
+            if a.field is None:
+                continue
+            col = np.asarray(self._host_view(batch).column(a.field))
+            if not np.issubdtype(col.dtype, np.floating):
+                continue
+            mask = ~np.isfinite(col)
+            bad = mask if bad is None else (bad | mask)
+        if bad is None or not bad.any():
+            return batch
+        hb = self._host_view(batch)
+        self._dead_letter(hb.filter(bad))
+        return hb.filter(~bad)
+
+    def _dead_letter(self, batch: RecordBatch) -> None:
+        """Quarantine a (host-viewed) batch: counted, side-emitted under
+        the 'dead-letter' tag when a side output is wired, never folded."""
+        DEVICE_STATS.note_dead_letter(batch.n)
+        self.quarantined_batches += 1
+        try:
+            self.output.emit_side("dead-letter", batch)
+        except NotImplementedError:
+            pass  # no side output wired: the counter is the record
+
+    def _degrade(self, cause: BaseException) -> None:
+        """Persistent compiled-segment failure: evacuate device state to
+        host through the existing snapshot path, rebuild the backend in
+        its synchronous host-fallback configuration, and pin this
+        operator to the CPU ingest path. Keyed state and the pane/fire
+        metadata survive verbatim, so exactly-once results are preserved;
+        the fault-injection sites stop firing for this operator (the
+        fallback of last resort is never chaos-injected)."""
+        if self._degraded:
+            raise cause
+        with FAULTS.suppressed():
+            self._drain(block=True)
+            while self._inflight:
+                jax.block_until_ready(self._inflight.popleft())
+            self._pre_fire_flush()
+            snap = self._backend.snapshot(-1)
+            if self._late_dev is not None:
+                self._late_dropped += int(jax.device_get(self._late_dev))
+                self._late_dev = None
+            from ...core.config import StateOptions
+            new_backend = TpuKeyedStateBackend(
+                self.ctx.key_group_range, self.ctx.max_parallelism,
+                capacity=self._capacity, defer_overflow=False,
+                hbm_budget_slots=0,
+                host_index=bool(self.ctx.config.get(
+                    StateOptions.TPU_HOST_INDEX)))
+            new_backend.restore([snap])
+        self._backend = new_backend
+        self._defer = False
+        self._stage = None
+        self._degraded = True
+        self._guard.active = False
+        DEVICE_STATS.note_degraded("device_window")
+
+    def _on_segment_failure(self, err: DeviceSegmentError,
+                            batch=None) -> bool:
+        """Shared escalation: poison faults quarantine the batch (returns
+        True: handled, nothing folded); anything else degrades when
+        allowed (returns False: caller re-runs through the fallback) or
+        re-raises into task failover."""
+        if err.poison and batch is not None:
+            self._dead_letter(self._host_view(batch))
+            return True
+        if self._degrade_enabled and not self._degraded:
+            self._degrade(err)
+            return False
+        raise err
 
     # -- device-resident ingest (zero-transfer hot path) --------------------
     def _fold_sig(self) -> tuple:
@@ -502,32 +611,51 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         if spill and self._stage is None:
             self._alloc_stage()
         sig = self._fold_sig()
-        step = _step_program(sig, self._ring, self._pane, self._offset,
-                             self._backend.dirty_block_size,
-                             self._backend.max_parallelism if spill else 0)
-        arrays = {n: self._backend.get_array(n)
-                  for n in self._fire_array_names()}
-        from ...ops.segment_ops import pow2_ceil
-
-        n = batch.n
-        P = pow2_ceil(n)
-
-        def _pad(a):
-            return (a if P == n
-                    else jnp.concatenate([a, jnp.zeros(P - n, a.dtype)]))
-
-        cols = {f: _pad(batch.device_column(f)) for _k, _n, f in sig}
         fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
-        table, new_arrays, dropped, late, dirty, stage, touch, token = step(
-            self._backend.table, arrays, self._backend.dropped_device,
-            self._late_dev, self._backend.dirty_mask,
-            self._stage if spill else None,
-            self._backend.touch_device if spill else None,
-            _pad(batch.device_column(self._key_column)),
-            _pad(batch.dtimestamps), cols,
-            self._backend.spilled_mask_device if spill else None,
-            np.int64(self._backend.note_batch()) if spill else np.int64(0),
-            fo, np.int64(n))
+
+        def dispatch():
+            step = _step_program(sig, self._ring, self._pane, self._offset,
+                                 self._backend.dirty_block_size,
+                                 self._backend.max_parallelism if spill
+                                 else 0)
+            arrays = {n: self._backend.get_array(n)
+                      for n in self._fire_array_names()}
+            from ...ops.segment_ops import pow2_ceil
+
+            n = batch.n
+            P = pow2_ceil(n)
+
+            def _pad(a):
+                return (a if P == n
+                        else jnp.concatenate([a, jnp.zeros(P - n, a.dtype)]))
+
+            cols = {f: _pad(batch.device_column(f)) for _k, _n, f in sig}
+            return step(
+                self._backend.table, arrays, self._backend.dropped_device,
+                self._late_dev, self._backend.dirty_mask,
+                self._stage if spill else None,
+                self._backend.touch_device if spill else None,
+                _pad(batch.device_column(self._key_column)),
+                _pad(batch.dtimestamps), cols,
+                self._backend.spilled_mask_device if spill else None,
+                np.int64(self._backend.note_batch()) if spill
+                else np.int64(0),
+                fo, np.int64(n))
+
+        try:
+            table, new_arrays, dropped, late, dirty, stage, touch, token = \
+                self._guard.run(dispatch)
+        except DeviceSegmentError as e:
+            if self._on_segment_failure(e, batch):
+                return  # poisoned batch quarantined; state untouched
+            # degraded mid-stream: this batch re-runs through the host
+            # ingest path against the evacuated state (nothing folded
+            # device-side — the fault fired before dispatch)
+            hb = self._host_view(batch)
+            keys = np.asarray(hb.column(self._key_column)).astype(
+                np.int64, copy=False)
+            self._ingest(hb, keys)
+            return
         self._backend.table = table
         for n, a in new_arrays.items():
             self._backend.set_array(n, a)
@@ -562,6 +690,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         # the slice program compiles O(log S) times, not once per count
         span = min(1 << (take - 1).bit_length() if take > 1 else 1,
                    self._stage_slots)
+        fire_with_retries("transfer.d2h", scope="device_window")
         host = jax.device_get({k: v[:span] for k, v in self._stage.items()
                                if k != "count"})
         DEVICE_STATS.note_d2h(pytree_nbytes(host), take)
@@ -609,18 +738,36 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             return np.concatenate([a, np.full(P - n, fill, a.dtype)])
 
         sig = self._fold_sig()
-        vals = tuple(jnp.asarray(_pad(np.asarray(batch.column(f)), 0))
-                     for _k, _n, f in sig)
-        valid = jnp.asarray(_pad(np.ones(n, bool), False))
-        DEVICE_STATS.note_h2d(
-            pytree_nbytes(vals) + valid.nbytes + flat.nbytes + slots.nbytes,
-            n)
-        arrays = {name: backend.get_array(name)
-                  for name in self._fire_array_names()}
-        prog = _native_fold_program(sig, backend.dirty_block_size)
-        out, dirty, token = prog(
-            arrays, backend.dirty_mask, jnp.asarray(_pad(flat, 0)),
-            jnp.asarray(_pad(slots, np.int32(0))), valid, vals)
+
+        def dispatch():
+            vals = tuple(jnp.asarray(_pad(np.asarray(batch.column(f)), 0))
+                         for _k, _n, f in sig)
+            valid = jnp.asarray(_pad(np.ones(n, bool), False))
+            DEVICE_STATS.note_h2d(
+                pytree_nbytes(vals) + valid.nbytes + flat.nbytes
+                + slots.nbytes, n)
+            arrays = {name: backend.get_array(name)
+                      for name in self._fire_array_names()}
+            prog = _native_fold_program(sig, backend.dirty_block_size)
+            return prog(
+                arrays, backend.dirty_mask, jnp.asarray(_pad(flat, 0)),
+                jnp.asarray(_pad(slots, np.int32(0))), valid, vals)
+
+        try:
+            out, dirty, token = self._guard.run(
+                dispatch, sites=("transfer.h2d", "device.execute"))
+        except DeviceSegmentError as e:
+            if e.poison:
+                self._dead_letter(self._host_view(batch))
+                return  # quarantined before folding; slots claimed but
+                # their count plane stays 0 so nothing ever emits
+            # the native fold IS already the host-fallback rung: there is
+            # no further backend to descend to — disarm injection for
+            # this operator and re-run the same fold
+            self._degraded = True
+            self._guard.active = False
+            DEVICE_STATS.note_degraded("device_window")
+            out, dirty, token = dispatch()
         for name, a in out.items():
             backend.set_array(name, a)
         backend.set_dirty_mask(dirty)
@@ -680,6 +827,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             else:
                 rows.append(col.astype(np.int64))
                 col_meta.append((name, False))
+        fire_with_retries("transfer.h2d", scope="device_window")
         buf = jnp.asarray(np.stack(rows))          # the ONE upload
         DEVICE_STATS.note_h2d(buf.nbytes, batch.n)
         slots = self._backend.slots_for_batch_device(buf[0])
@@ -717,15 +865,25 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         pane_rows[:len(rows)] = rows
         rows_valid = np.zeros(W, bool)
         rows_valid[:len(rows)] = True
-        fire_fn = _fire_program(
-            tuple((a.kind, a.out_name) for a in self._aggs), self._topk,
-            self._aggs[0].value_bits if self._topk is not None and self._aggs
-            else 64)
-        arrays = {n: self._backend.get_array(n)
-                  for n in self._fire_array_names()}
-        outs = fire_fn(self._backend.table, arrays,
-                       jnp.asarray(pane_rows), jnp.asarray(rows_valid),
-                       self._backend.dropped_device)
+        def dispatch():
+            fire_fn = _fire_program(
+                tuple((a.kind, a.out_name) for a in self._aggs), self._topk,
+                self._aggs[0].value_bits
+                if self._topk is not None and self._aggs else 64)
+            arrays = {n: self._backend.get_array(n)
+                      for n in self._fire_array_names()}
+            return fire_fn(self._backend.table, arrays,
+                           jnp.asarray(pane_rows), jnp.asarray(rows_valid),
+                           self._backend.dropped_device)
+
+        try:
+            outs = self._guard.run(dispatch)
+        except DeviceSegmentError as e:
+            # a fire has no batch to quarantine: persistent failure walks
+            # the degradation ladder (state evacuates; the re-dispatch
+            # reads the rebuilt backend), or re-raises into task failover
+            self._on_segment_failure(e)
+            outs = dispatch()
         # the host spill tier's rows merge at materialization; take them
         # NOW (before this fire retires the pane row below)
         host_part = (self._host_fire_part(np.array(rows, np.int32))
@@ -770,6 +928,8 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
     def _materialize(self, item) -> None:
         t_drain = time.perf_counter()
         p_end, outs, host_part, t0 = item
+        if self._guard is None or self._guard.active:
+            fire_with_retries("transfer.d2h", scope="device_window")
         host = jax.device_get(outs)       # ONE transfer for everything
         d2h_bytes = pytree_nbytes(host)
         if self._topk is not None:
@@ -805,6 +965,21 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
 
     def _emit_rows(self, p_end: int, keys: np.ndarray,
                    results: dict[str, np.ndarray]) -> None:
+        if self._validate_batches and len(keys):
+            # screen fire RESULTS too: a non-finite aggregate (however it
+            # got into the plane) rides the dead-letter output, not the
+            # main stream
+            bad = np.zeros(len(keys), bool)
+            for v in results.values():
+                if np.issubdtype(np.asarray(v).dtype, np.floating):
+                    bad |= ~np.isfinite(v)
+            if bad.any():
+                DEVICE_STATS.note_dead_letter(int(bad.sum()))
+                keep = ~bad
+                keys = keys[keep]
+                results = {n_: v[keep] for n_, v in results.items()}
+                if not len(keys):
+                    return
         n = len(keys)
         start = (p_end - self._window_panes) * self._pane + self._offset
         end = p_end * self._pane + self._offset

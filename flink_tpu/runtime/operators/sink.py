@@ -31,6 +31,8 @@ class SinkOperator(OneInputOperator):
             self._writer.restore(operator_snapshot)
 
     def process_batch(self, batch: RecordBatch) -> None:
+        from ..faults import fire_with_retries
+        fire_with_retries("sink.invoke")
         self._writer.write_batch(batch)
 
     def snapshot_state(self, checkpoint_id: int) -> dict:
@@ -61,6 +63,8 @@ class FunctionSinkOperator(OneInputOperator):
         self._fn = fn
 
     def process_batch(self, batch: RecordBatch) -> None:
+        from ..faults import fire_with_retries
+        fire_with_retries("sink.invoke")
         if self._fn.invoke_batch(batch):
             return
         for i, row in enumerate(batch.iter_rows()):

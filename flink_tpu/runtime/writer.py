@@ -28,6 +28,7 @@ from ..core.keygroups import hash_batch, key_groups_for_hash_batch, \
     operator_index_for_key_group
 from ..core.records import RecordBatch
 from .channels import Channel
+from .faults import FAULTS, fire_with_retries
 
 __all__ = [
     "StreamPartitioner", "ForwardPartitioner", "RebalancePartitioner",
@@ -184,6 +185,12 @@ class RecordWriter:
     def emit(self, batch: RecordBatch) -> None:
         if not batch.n:
             return
+        # fault site channel.send (docs/ROBUSTNESS.md): a transient trip
+        # models one failed flush — retried in place, counted as a retry;
+        # a persistent trip fails the task and recovers through the job
+        # restart strategy exactly like a severed transport connection
+        if FAULTS.enabled:
+            fire_with_retries("channel.send")
         for idx, part in self.partitioner.route(
                 batch, len(self.channels), self.subtask_index):
             self._put_blocking(self.channels[idx], part)

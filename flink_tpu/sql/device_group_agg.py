@@ -222,13 +222,16 @@ class DeviceGroupAggOperator(OneInputOperator):
                 return a
             return np.concatenate([a, np.full(pad, fill, a.dtype)])
 
+        from ..runtime.faults import fire_with_retries
         vals = tuple(jnp.asarray(_padded(
             np.asarray(batch.column(c), np.float64), 0.0))
             for c in col_names)
+        fire_with_retries("transfer.h2d", scope="device_group_agg")
         DEVICE_STATS.note_h2d(pytree_nbytes(vals) + P * 8, n)  # vals + sign
         # pads alias the first real key: no new table slots, and the
         # program's n_valid mask keeps them out of every fold
         slots = self._backend.slots_for_batch(_padded(keys, keys[0]))
+        fire_with_retries("device.execute", scope="device_group_agg")
         step = _gagg_program(tuple(fold_sig),
                              self._backend.dirty_block_size)
         planes = {"__rc__": self._backend.get_array("__rc__")}
@@ -243,6 +246,7 @@ class DeviceGroupAggOperator(OneInputOperator):
         g = int(jax.device_get(n_groups))
         if g == 0:
             return
+        fire_with_retries("transfer.d2h", scope="device_group_agg")
         span = min(1 << (g - 1).bit_length() if g > 1 else 1, P)
         host = jax.device_get({
             "idx": row_idx[:span],

@@ -280,6 +280,10 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         # slots (native slots are dense, XLA slots lie on the probe
         # sequence).
         self._hi = None
+        if config is not None:
+            from ..core.config import StateOptions
+            host_index = host_index and bool(
+                config.get(StateOptions.TPU_HOST_INDEX))
         if host_index and not self._budget \
                 and jax.default_backend() == "cpu":
             try:
@@ -441,6 +445,8 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         built). Tracks the DMA bytes of this capture."""
         nb, bs = self._n_blocks, self._block
         self.last_snapshot_dma_bytes = 0
+        from ..runtime.faults import fire_with_retries
+        fire_with_retries("transfer.d2h", scope="tpu_backend.snapshot")
         if self._mirror is None:
             # writable copies: device_get may return read-only views
             t = np.array(jax.device_get(self.table))
@@ -1071,6 +1077,8 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 per_state_vals.setdefault(name, []).append(vals)
         keys = (np.concatenate(all_keys) if all_keys
                 else np.empty(0, np.int64))
+        from ..runtime.faults import fire_with_retries
+        fire_with_retries("transfer.h2d", scope="tpu_backend.restore")
         while self.capacity < 2 * max(len(keys), 1):
             self.capacity *= 2  # may exceed the budget; evicted back below
         self.table = make_table(self.capacity)

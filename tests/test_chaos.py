@@ -1,0 +1,308 @@
+"""Deterministic chaos trials: fault injection at every device-path site
+with exactly-once results asserted against a numpy oracle, forced
+mid-stream degradation vs a clean run, and dead-letter quarantine
+accounting. All fast enough for tier-1 (the `chaos` marker selects them
+for dedicated runs; `python bench.py --chaos SEED` drives the same
+schedule through the full tiny-Q5 stage)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.config import (
+    CheckpointingOptions, Configuration, FaultOptions, PipelineOptions,
+    StateOptions,
+)
+from flink_tpu.core.device_records import DeviceRecordBatch
+from flink_tpu.core.functions import SinkFunction
+from flink_tpu.core.records import RecordBatch, Schema
+from flink_tpu.metrics.device import DEVICE_STATS
+from flink_tpu.runtime import faults as faults_mod
+from flink_tpu.runtime.harness import OneInputOperatorTestHarness
+from flink_tpu.runtime.operators.device_window import (
+    AggSpec, DeviceWindowAggOperator,
+)
+
+pytestmark = pytest.mark.chaos
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+PANE = 1000
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults_mod.FAULTS.reset()
+    yield
+    faults_mod.FAULTS.reset()
+
+
+def _chaos_config(spec: str, seed: int = 0) -> Configuration:
+    cfg = Configuration()
+    cfg.set(StateOptions.TPU_HOST_INDEX, False)  # force the XLA path
+    if spec:
+        cfg.set(FaultOptions.ENABLED, True)
+        cfg.set(FaultOptions.SEED, seed)
+        cfg.set(FaultOptions.SPEC, spec)
+    return cfg
+
+
+def _make_op(**kw) -> DeviceWindowAggOperator:
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    return DeviceWindowAggOperator(
+        TumblingEventTimeWindows.of(PANE), "k",
+        [AggSpec("count", out_name="cnt", value_bits=31),
+         AggSpec("sum", "v", out_name="total")],
+        capacity=1 << 12, ring_size=8, emit_window_bounds=True, **kw)
+
+
+def _device_batch(keys, vals, ts) -> DeviceRecordBatch:
+    cols = {"k": jnp.asarray(keys), "v": jnp.asarray(vals)}
+    return DeviceRecordBatch(SCHEMA, cols, jnp.asarray(ts),
+                             int(ts.min()), int(ts.max()))
+
+
+def _gen(seed: int, n: int, n_keys: int = 13):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    vals = rng.integers(1, 50, n).astype(np.int64)
+    ts = np.sort(rng.integers(0, 6 * PANE, n)).astype(np.int64)
+    return keys, vals, ts
+
+
+def _expected(keys, vals, ts, skip=()) -> dict:
+    """Oracle: per (key, window_end) count/sum; ``skip`` masks rows that
+    the run quarantined on purpose."""
+    out: dict = {}
+    for i, (k, v, t) in enumerate(zip(keys, vals, ts)):
+        if i in skip:
+            continue
+        end = (int(t) // PANE + 1) * PANE
+        c, s = out.get((int(k), end), (0, 0))
+        out[(int(k), end)] = (c + 1, s + int(v))
+    return out
+
+
+def _run_device_trial(spec: str, seed: int, data_seed: int = 0,
+                      batches: int = 6, batch_n: int = 256,
+                      config: Configuration = None,
+                      device_batches: bool = True):
+    """Drive the device window operator through the harness; returns
+    (emitted dict, operator, raw data)."""
+    cfg = config if config is not None else _chaos_config(spec, seed)
+    op = _make_op(defer_overflow=device_batches)
+    h = OneInputOperatorTestHarness(op, SCHEMA, config=cfg)
+    faults_mod.FAULTS.configure(cfg)
+    keys, vals, ts = _gen(data_seed, batches * batch_n)
+    for b in range(batches):
+        sl = slice(b * batch_n, (b + 1) * batch_n)
+        if device_batches:
+            h.process_batch(_device_batch(keys[sl], vals[sl], ts[sl]))
+        else:
+            h.process_batch(RecordBatch(
+                SCHEMA, {"k": keys[sl], "v": vals[sl]}, ts[sl]))
+        h.process_watermark(int(ts[sl][-1]) - PANE)
+    h.process_watermark(1 << 40)
+    h.close()
+    got = {}
+    for row in h.get_output():
+        k, ws, we, cnt, total = row
+        assert (k, we) not in got, "window emitted twice (not exactly-once)"
+        got[(k, we)] = (int(cnt), int(total))
+    return got, op, h, (keys, vals, ts)
+
+
+# ---------------------------------------------------------------------------
+# chaos smoke: every device-path site armed, exactly-once results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exactly_once_with_all_device_sites_armed(seed):
+    """Transient/bounded faults at device.compile, device.execute,
+    transfer.h2d, transfer.d2h: results must match the oracle exactly —
+    every trip is absorbed by retry, never by dropping or double-folding
+    data."""
+    spec = ("device.compile=once@1,device.execute=p0.1,"
+            "transfer.h2d=p0.1,transfer.d2h=p0.1")
+    got, op, h, (keys, vals, ts) = _run_device_trial(spec, seed)
+    assert got == _expected(keys, vals, ts)
+    assert not op._degraded
+    snap = faults_mod.FAULTS.snapshot()
+    assert sum(snap["trips"].values()) > 0, "chaos run injected nothing"
+
+
+def test_chaos_counters_reach_prometheus():
+    """The acceptance surface: device_retries_total /
+    device_degraded_total / dead_letter_records_total appear in the
+    /metrics exposition and move under injection."""
+    from flink_tpu.metrics.core import MetricRegistry
+    from flink_tpu.metrics.device import bind_device_metrics
+    from flink_tpu.metrics.reporters import prometheus_text
+
+    before = DEVICE_STATS.retries
+    _run_device_trial("device.execute=p0.2,transfer.d2h=p0.2", seed=5)
+    assert DEVICE_STATS.retries > before
+    reg = MetricRegistry()
+    bind_device_metrics(reg)
+    text = prometheus_text(reg)
+    for name in ("device_retries_total", "device_degraded_total",
+                 "dead_letter_records_total", "injected_faults_total"):
+        assert name in text, f"{name} missing from /metrics"
+    snap = DEVICE_STATS.snapshot()
+    assert snap["device_retries_total"] == DEVICE_STATS.retries
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder: persistent failure -> evacuate -> CPU fallback
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("device_batches", [True, False])
+def test_forced_degradation_matches_clean_run(device_batches):
+    """Mid-stream persistent device.execute failure: the operator
+    evacuates state through the snapshot path and finishes on the CPU
+    fallback — emitted windows must be IDENTICAL to a fault-free run
+    (no lost keyed state, no duplicate fires)."""
+    clean, op0, _h0, data = _run_device_trial(
+        "", seed=0, device_batches=device_batches)
+    assert not op0._degraded
+    faults_mod.FAULTS.reset()
+    d0 = DEVICE_STATS.degraded
+    got, op, _h, _ = _run_device_trial(
+        "device.execute=once@2!persistent", seed=0,
+        device_batches=device_batches)
+    assert op._degraded, "persistent fault never degraded the operator"
+    assert DEVICE_STATS.degraded == d0 + 1
+    assert got == clean
+    keys, vals, ts = data
+    assert got == _expected(keys, vals, ts)
+
+
+def test_degradation_disabled_propagates():
+    cfg = _chaos_config("device.execute=once@1!persistent", seed=0)
+    cfg.set(FaultOptions.DEGRADATION, False)
+    with pytest.raises(Exception) as ei:
+        _run_device_trial("", seed=0, config=cfg)
+    assert "device segment" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# dead-letter quarantine
+# ---------------------------------------------------------------------------
+
+def test_poison_fault_quarantines_batch_not_state():
+    """A poison trip on the 3rd step dispatch: that batch rides the
+    dead-letter counter, every other batch folds normally, and state is
+    never poisoned (results match the oracle minus the quarantined
+    rows)."""
+    dl0 = DEVICE_STATS.dead_letter_records
+    batches, batch_n = 6, 256
+    got, op, h, (keys, vals, ts) = _run_device_trial(
+        "device.execute=once@3!poison", seed=0,
+        batches=batches, batch_n=batch_n)
+    assert op.quarantined_batches == 1
+    assert DEVICE_STATS.dead_letter_records == dl0 + batch_n
+    skip = set(range(2 * batch_n, 3 * batch_n))  # the 3rd batch
+    assert got == _expected(keys, vals, ts, skip=skip)
+    assert not op._degraded
+
+
+def test_validate_batches_quarantines_nonfinite_rows():
+    """faults.validate-batches: NaN rows in a float aggregate column are
+    diverted to the dead-letter side output instead of poisoning the sum
+    plane."""
+    schema = Schema([("k", np.int64), ("x", np.float64)])
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    cfg = Configuration()
+    cfg.set(StateOptions.TPU_HOST_INDEX, False)
+    cfg.set(FaultOptions.VALIDATE_BATCHES, True)
+    op = DeviceWindowAggOperator(
+        TumblingEventTimeWindows.of(PANE), "k",
+        [AggSpec("sum", "x", out_name="sx")],
+        capacity=1 << 10, ring_size=8, emit_window_bounds=False)
+    h = OneInputOperatorTestHarness(op, schema, config=cfg)
+    dl0 = DEVICE_STATS.dead_letter_records
+    keys = np.array([1, 1, 2, 2], np.int64)
+    xs = np.array([1.0, np.nan, 2.0, np.inf], np.float64)
+    ts = np.array([10, 20, 30, 40], np.int64)
+    h.process_batch(RecordBatch(schema, {"k": keys, "x": xs}, ts))
+    h.process_watermark(1 << 40)
+    h.close()
+    assert DEVICE_STATS.dead_letter_records == dl0 + 2
+    rows = {r[0]: r[1] for r in h.get_output()}
+    assert rows == {1: 1.0, 2: 2.0}
+    # the poisoned rows surface on the dead-letter side output
+    assert len(h.get_side_output("dead-letter")) == 2
+
+
+# ---------------------------------------------------------------------------
+# whole-pipeline chaos: tiny Q5-shaped job, every site armed, 3 seeds
+# ---------------------------------------------------------------------------
+
+class _RowSink(SinkFunction):
+    def __init__(self):
+        self.rows = []
+
+    def invoke_batch(self, batch):
+        self.rows.extend(batch.iter_rows())
+        return True
+
+
+@pytest.mark.parametrize("seed", [7, 11, 13])
+def test_tiny_q5_pipeline_exactly_once_under_chaos(seed):
+    """The acceptance trial: the tiny Q5-shaped pipeline (datagen ->
+    keyBy -> device tumbling aggregate -> sink) completes with
+    exactly-once results with faults armed at every named site. All
+    schedules are transient/bounded so recovery happens IN PLACE (retry
+    / injected backpressure / tolerated checkpoint-write failure), which
+    keeps the emitted stream free of restart replays."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    n, n_keys = 1 << 12, 37
+    spec = ("device.compile=once@1,device.execute=p0.03,"
+            "transfer.h2d=p0.03,transfer.d2h=p0.03,"
+            "channel.send=once@2,channel.backpressure=every@13,"
+            "checkpoint.write=once@1,sink.invoke=once@2,"
+            "rpc.heartbeat=every@5")
+
+    def gen(idx):
+        return {"k": (idx * 7) % n_keys,
+                "v": (idx % 19) + 1,
+                "ts": (idx * 6 * PANE) // n}
+
+    schema = Schema([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+    env = StreamExecutionEnvironment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, 512)
+    env.config.set(StateOptions.TPU_HOST_INDEX, False)
+    env.config.set(CheckpointingOptions.INTERVAL, 0.05)
+    env.config.set(FaultOptions.ENABLED, True)
+    env.config.set(FaultOptions.SEED, seed)
+    env.config.set(FaultOptions.SPEC, spec)
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _RowSink()
+    (env.datagen(gen, schema, count=n, timestamp_column="ts",
+                 watermark_strategy=ws)
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(PANE))
+        .device_aggregate([AggSpec("count", out_name="cnt", value_bits=31),
+                           AggSpec("sum", "v", out_name="total")],
+                          capacity=1 << 12, ring_size=8,
+                          emit_window_bounds=True, defer_overflow=True)
+        .add_sink(sink.fn if hasattr(sink, "fn") else sink, "sink"))
+    env.execute(f"tiny-q5-chaos-{seed}", timeout=120.0)
+
+    idx = np.arange(n)
+    keys = (idx * 7) % n_keys
+    vals = (idx % 19) + 1
+    ts = (idx * 6 * PANE) // n
+    expect = _expected(keys, vals, ts)
+    got = {}
+    for k, _ws, we, cnt, total in sink.rows:
+        assert (int(k), int(we)) not in got, "duplicate window emission"
+        got[(int(k), int(we))] = (int(cnt), int(total))
+    assert got == expect, f"seed {seed}: results diverged under chaos"
+    assert DEVICE_STATS.injected_faults > 0

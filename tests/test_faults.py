@@ -1,0 +1,269 @@
+"""Unit tests for the deterministic fault-injection framework
+(runtime/faults.py), the DeviceGuard retry/escalate ladder, and the
+hardened restart strategies (cluster/failover.py)."""
+
+import time
+
+import pytest
+
+from flink_tpu.cluster.failover import (
+    ExponentialDelayRestartStrategy, FailureRateRestartStrategy,
+)
+from flink_tpu.core.config import Configuration
+from flink_tpu.runtime.faults import (
+    DeviceGuard, DeviceSegmentError, FaultInjector, FaultRule,
+    InjectedFault, fire_with_retries,
+)
+from flink_tpu.runtime import faults as faults_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_injector():
+    faults_mod.FAULTS.reset()
+    yield
+    faults_mod.FAULTS.reset()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+def test_rule_parsing():
+    r = FaultRule.parse("device.execute=once@5")
+    assert (r.mode, r.at, r.transient, r.poison) == ("once", 5, True, False)
+    r = FaultRule.parse("transfer.h2d=p0.25!persistent")
+    assert (r.mode, r.p, r.transient) == ("prob", 0.25, False)
+    r = FaultRule.parse("device.execute=every@3!poison")
+    assert (r.mode, r.at, r.poison) == ("every", 3, True)
+    assert FaultRule.parse("sink.invoke=always").mode == "always"
+    assert FaultRule.parse("sink.invoke=once").at == 1
+
+
+def test_rule_parsing_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultRule.parse("not.a.site=always")
+    with pytest.raises(ValueError):
+        FaultRule.parse("sink.invoke=sometimes")
+    with pytest.raises(ValueError):
+        FaultRule.parse("sink.invoke=p1.5")
+    with pytest.raises(ValueError):
+        FaultRule.parse("sink.invoke=always!loudly")
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+def test_disabled_injector_never_trips():
+    inj = FaultInjector()
+    for _ in range(100):
+        inj.fire("device.execute")  # no spec -> no-op
+    inj.configure_spec("device.execute=always", enabled=False)
+    for _ in range(100):
+        inj.fire("device.execute")
+
+
+def test_once_at_n_trips_exactly_once():
+    inj = FaultInjector()
+    inj.configure_spec("device.execute=once@4")
+    trips = []
+    for i in range(1, 10):
+        try:
+            inj.fire("device.execute")
+        except InjectedFault as e:
+            trips.append((i, e.visit))
+    assert trips == [(4, 4)]
+
+
+def test_every_n_schedule():
+    inj = FaultInjector()
+    inj.configure_spec("transfer.h2d=every@3")
+    hits = []
+    for i in range(1, 10):
+        try:
+            inj.fire("transfer.h2d")
+        except InjectedFault:
+            hits.append(i)
+    assert hits == [3, 6, 9]
+
+
+def test_probability_schedule_replays_byte_identically():
+    def run(seed):
+        inj = FaultInjector()
+        inj.configure_spec("device.execute=p0.3", seed=seed)
+        out = []
+        for i in range(200):
+            try:
+                inj.fire("device.execute")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = run(42), run(42)
+    assert a == b and sum(a) > 0
+    assert run(43) != a  # a different seed is a different schedule
+
+
+def test_check_is_drop_style():
+    inj = FaultInjector()
+    inj.configure_spec("rpc.heartbeat=every@2")
+    assert [inj.check("rpc.heartbeat") for _ in range(4)] == \
+        [False, True, False, True]
+
+
+def test_suppression_context():
+    inj = FaultInjector()
+    inj.configure_spec("device.execute=always")
+    with inj.suppressed():
+        inj.fire("device.execute")  # no trip inside
+    with pytest.raises(InjectedFault):
+        inj.fire("device.execute")
+
+
+def test_configure_is_idempotent_on_same_fingerprint():
+    """A failover redeploy with the SAME config must keep visit counters
+    (a once@N fault must not re-arm every restart attempt)."""
+    from flink_tpu.core.config import FaultOptions
+    cfg = Configuration()
+    cfg.set(FaultOptions.ENABLED, True)
+    cfg.set(FaultOptions.SPEC, "sink.invoke=once@2")
+    inj = FaultInjector()
+    inj.configure(cfg)
+    inj.fire("sink.invoke")
+    with pytest.raises(InjectedFault):
+        inj.fire("sink.invoke")
+    inj.configure(cfg)           # redeploy, same config: no reset
+    inj.fire("sink.invoke")      # visit 3: already tripped, stays quiet
+    inj.configure(cfg.clone().set(FaultOptions.SEED, 9))  # NEW config
+    inj.fire("sink.invoke")
+    with pytest.raises(InjectedFault):
+        inj.fire("sink.invoke")  # re-armed: counters restarted
+
+
+def test_snapshot_counts_visits_and_trips():
+    inj = FaultInjector()
+    inj.configure_spec("device.execute=every@2")
+    for _ in range(4):
+        try:
+            inj.fire("device.execute")
+        except InjectedFault:
+            pass
+    snap = inj.snapshot()
+    assert snap["visits"]["device.execute"] == 4
+    assert snap["trips"]["device.execute"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fire_with_retries / DeviceGuard
+# ---------------------------------------------------------------------------
+
+def test_fire_with_retries_absorbs_transient(monkeypatch):
+    from flink_tpu.metrics.device import DEVICE_STATS
+    faults_mod.FAULTS.configure_spec("transfer.h2d=once@1")
+    before = DEVICE_STATS.retries
+    retries = fire_with_retries("transfer.h2d", scope="t")
+    assert retries == 1
+    assert DEVICE_STATS.retries == before + 1
+
+
+def test_fire_with_retries_propagates_persistent():
+    faults_mod.FAULTS.configure_spec("transfer.h2d=always!persistent")
+    with pytest.raises(InjectedFault):
+        fire_with_retries("transfer.h2d")
+
+
+def test_guard_retries_then_succeeds():
+    faults_mod.FAULTS.configure_spec("device.execute=once@1")
+    guard = DeviceGuard("t")
+    calls = []
+    out = guard.run(lambda: calls.append(1) or "ok")
+    assert out == "ok" and guard.retries == 1 and len(calls) == 1
+
+
+def test_guard_escalates_persistent_to_segment_error():
+    faults_mod.FAULTS.configure_spec("device.execute=always!persistent")
+    guard = DeviceGuard("t")
+    with pytest.raises(DeviceSegmentError) as ei:
+        guard.run(lambda: "never")
+    assert not ei.value.poison
+
+
+def test_guard_exhausts_transient_always():
+    faults_mod.FAULTS.configure_spec("device.execute=always")
+    guard = DeviceGuard("t")
+    with pytest.raises(DeviceSegmentError):
+        guard.run(lambda: "never")
+    assert guard.retries == guard.max_retries
+
+
+def test_guard_poison_skips_retry():
+    faults_mod.FAULTS.configure_spec("device.execute=once@1!poison")
+    guard = DeviceGuard("t")
+    with pytest.raises(DeviceSegmentError) as ei:
+        guard.run(lambda: "never")
+    assert ei.value.poison and guard.retries == 0
+
+
+def test_guard_inactive_is_passthrough():
+    faults_mod.FAULTS.configure_spec("device.execute=always!persistent")
+    guard = DeviceGuard("t")
+    guard.active = False
+    assert guard.run(lambda: 7) == 7
+
+
+def test_guard_leaves_programming_errors_alone():
+    guard = DeviceGuard("t")
+    with pytest.raises(TypeError):
+        guard.run(lambda: (_ for _ in ()).throw(TypeError("bug")))
+
+
+# ---------------------------------------------------------------------------
+# hardened restart strategies (satellite)
+# ---------------------------------------------------------------------------
+
+def test_exponential_recovered_resets_escalation(monkeypatch):
+    now = [1000.0]
+    monkeypatch.setattr(time, "time", lambda: now[0])
+    s = ExponentialDelayRestartStrategy(initial=0.1, maximum=10.0,
+                                        multiplier=2.0, reset_after=60.0)
+    s.notify_failure()
+    now[0] += 1
+    s.notify_failure()
+    assert s.backoff_seconds() == pytest.approx(0.2)
+    s.notify_recovered()
+    assert s.backoff_seconds() == pytest.approx(0.1)
+    # the FIRST failure after recovery must start at initial again, even
+    # though it lands inside the old reset_after window
+    now[0] += 1
+    s.notify_failure()
+    assert s.backoff_seconds() == pytest.approx(0.1)
+
+
+def test_failure_rate_window_prunes_without_new_failures(monkeypatch):
+    now = [2000.0]
+    monkeypatch.setattr(time, "time", lambda: now[0])
+    s = FailureRateRestartStrategy(max_failures=2, interval=10.0, delay=0.0)
+    for _ in range(4):
+        s.notify_failure()
+    assert not s.can_restart()
+    # the burst ages out with NO further notify_failure calls: can_restart
+    # must prune time-based, not only on the next failure
+    now[0] += 11.0
+    assert s.can_restart()
+
+
+def test_distributed_coordinator_hb_timeout_from_config():
+    """Satellite: _hb_timeout is derived from heartbeat.interval at
+    construction (same formula monitor() later receives), so a worker
+    dying before monitor() starts uses the configured window."""
+    from flink_tpu.cluster.distributed import _Coordinator
+    from flink_tpu.core.config import RuntimeOptions
+
+    cfg = Configuration()
+    cfg.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.2)
+    coord = _Coordinator(1, cfg)
+    try:
+        assert coord._hb_timeout == pytest.approx(3 * 0.2 + 2.0)
+    finally:
+        coord.close()

@@ -68,6 +68,39 @@ def test_dashboard_and_flamegraph():
         job.cancel()
 
 
+def test_exception_history_endpoint():
+    """GET /jobs/<name>/exceptions returns the bounded failure history
+    (task failures recorded by the LocalJob reporter, restart decisions
+    from the supervisor), newest first."""
+    from flink_tpu.cluster.rest import RestEndpoint
+
+    env, job = _running_job(n=50_000)
+    # synthesize a recorded failure (the reporter path appends these)
+    job.failure_history.append({
+        "timestamp": 123.0, "task": "v1#0", "kind": "task-failure",
+        "error": "RuntimeError: injected"})
+    job.failure_history.append({
+        "timestamp": 456.0, "attempt": 1, "kind": "restart",
+        "error": "RuntimeError: injected", "restored_checkpoint": 3})
+    ep = RestEndpoint(port=0)
+    ep.register_job("ui-job", job)
+    port = ep.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        status, body = _get(f"{base}/jobs/ui-job/exceptions")
+        assert status == 200
+        payload = json.loads(body)
+        kinds = [e["kind"] for e in payload["entries"]]
+        assert kinds[:2] == ["restart", "task-failure"]  # newest first
+        assert payload["entries"][1]["error"].startswith("RuntimeError")
+
+        status, _body = _get(f"{base}/jobs/nope/exceptions")
+        assert status == 404
+    finally:
+        ep.stop()
+        job.cancel()
+
+
 def test_history_server_archives_completed_job(tmp_path):
     from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
     from flink_tpu.cluster.webui import HistoryServer, archive_job
