@@ -39,29 +39,47 @@ import numpy as np
 _CPU_FALLBACK = False
 
 
-def probe_backend(timeout_s: float = 75.0) -> dict:
-    """Probe device availability in a SUBPROCESS with a hard timeout: the
-    chip sits behind a shared tunnel and jax backend init can hang for
-    hours when it is down (r3: the whole bench died with a raw traceback
-    and the driver got rc=1 and zero information). A dead probe degrades
-    to a clearly-labeled CPU fallback with rc=0 instead."""
+def probe_backend(timeout_s: float = None, _cmd: list = None) -> dict:
+    """Probe device availability in a SUBPROCESS (isolation: jax backend
+    init can hang for hours when the chip's shared tunnel is down — r3:
+    the whole bench died with a raw traceback and the driver got rc=1 and
+    zero information). The hang handling itself is the stall watchdog's
+    (site ``bench.probe``, deadline ``bench.probe-timeout`` — one code
+    path with every other supervised site, no magic number here): a
+    stalled probe kills the subprocess and degrades to a clearly-labeled
+    CPU fallback with rc=0, reporting the watchdog trip."""
+    from flink_tpu.runtime.watchdog import StallError, WATCHDOG
+
+    deadline = (WATCHDOG.deadline_for("bench.probe")
+                if timeout_s is None else timeout_s)
+    cmd = _cmd or [sys.executable, "-c",
+                   "import jax; print(jax.devices()[0].platform)"]
     t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+    def _communicate():
+        from flink_tpu.runtime.faults import FAULTS
+        if FAULTS.enabled:
+            FAULTS.fire("bench.probe")  # injectable (hangs included)
+        return proc.communicate()
+
     try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=timeout_s, text=True)
-        dt = time.perf_counter() - t0
-        if out.returncode == 0:
-            platform = out.stdout.strip().splitlines()[-1]
-            return {"platform": platform, "probe_s": round(dt, 1)}
-        return {"error": "backend_init_failed", "probe_s": round(dt, 1),
-                "detail": out.stderr.strip()[-300:]}
-    except subprocess.TimeoutExpired:
+        out, err = WATCHDOG.run("bench.probe", _communicate,
+                                deadline=deadline, scope="bench",
+                                on_stall=proc.kill)
+    except StallError:
         return {"error": "tpu_unreachable",
                 "probe_s": round(time.perf_counter() - t0, 1),
-                "detail": f"device probe hung > {timeout_s:.0f}s "
+                "watchdog_trips": WATCHDOG.trips.get("bench.probe", 0),
+                "detail": f"device probe stalled > {deadline:.3g}s "
                           "(tunnel down)"}
+    dt = time.perf_counter() - t0
+    if proc.returncode == 0:
+        platform = out.strip().splitlines()[-1]
+        return {"platform": platform, "probe_s": round(dt, 1)}
+    return {"error": "backend_init_failed", "probe_s": round(dt, 1),
+            "detail": err.strip()[-300:]}
 
 
 def _ensure_backend() -> dict:
@@ -186,10 +204,11 @@ def _collect_metrics(env, before: dict) -> dict:
                                 "compile_ms", "h2d_bytes", "h2d_records",
                                 "d2h_bytes", "d2h_records")}
     out["recompiles"] = snap["compiles"] - before.get("compiles", 0)
-    # degradation-ladder counters (deltas for this run): nonzero only
-    # under injection or a genuinely failing device path
+    # degradation-ladder + stall counters (deltas for this run): nonzero
+    # only under injection or a genuinely failing/hanging device path
     for k in ("device_retries_total", "device_degraded_total",
-              "dead_letter_records_total", "injected_faults_total"):
+              "dead_letter_records_total", "injected_faults_total",
+              "watchdog_trips_total", "stall_detections_total"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
@@ -308,9 +327,15 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
     if chaos_seed is not None:
         extra = {"faults.enabled": True, "faults.seed": int(chaos_seed),
                  "faults.spec": CHAOS_SPEC,
+                 # tighten the transfer deadline under the injected d2h
+                 # hangs so the chaos run exercises the watchdog
+                 # stall->retry path (watchdog_trips_total > 0)
+                 "watchdog.transfer-timeout": 0.012,
                  "state.backend.tpu.host-index": False}
         from flink_tpu.runtime.faults import FAULTS
+        from flink_tpu.runtime.watchdog import WATCHDOG
         FAULTS.reset()  # arm fresh: visit counters start at zero
+        WATCHDOG.reset()
     _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
             metrics_registry=metrics_registry)              # compile warmup
     wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
@@ -323,19 +348,26 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
     stages["emitted_rows"] = rows
     if chaos_seed is not None:
         from flink_tpu.runtime.faults import FAULTS
+        from flink_tpu.runtime.watchdog import WATCHDOG
         stages["chaos_seed"] = int(chaos_seed)
         stages["chaos_trips"] = FAULTS.snapshot()["trips"]
+        stages["watchdog_trips"] = dict(WATCHDOG.trips)
         FAULTS.reset()
+        WATCHDOG.reset()
     return stages
 
 
 #: The --chaos schedule: every device-path site armed with a bounded or
 #: probabilistic transient schedule, so the run completes while still
 #: exercising retry, injected backpressure, quarantine-free recovery, and
-#: the failed-checkpoint-write tolerance. (Persistent-degradation trials
-#: live in tests/test_chaos.py where results are asserted exactly.)
+#: the failed-checkpoint-write tolerance. transfer.d2h injects HANGS on a
+#: bounded schedule (never two consecutive visits) so the watchdog
+#: stall->abandon->retry path runs too, under the tightened transfer
+#: deadline run_tiny_q5 sets for chaos runs. (Persistent-degradation and
+#: stall-to-degrade trials live in tests/test_chaos.py where results are
+#: asserted exactly.)
 CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
-              "transfer.h2d=p0.05,transfer.d2h=p0.05,"
+              "transfer.h2d=p0.05,transfer.d2h=every@5!hang@30,"
               "channel.send=once@3,channel.backpressure=every@17,"
               "checkpoint.write=once@1,sink.invoke=once@2,"
               "rpc.heartbeat=every@5")
@@ -788,7 +820,9 @@ def _print_breakdown(stages: dict, prefix: str) -> None:
                     ("recompiles", "programs"), ("compile_ms", "ms"),
                     ("h2d_bytes", "bytes"), ("d2h_bytes", "bytes"),
                     ("busy_time_ratio", "ratio"),
-                    ("backpressured_time_ratio", "ratio")):
+                    ("backpressured_time_ratio", "ratio"),
+                    ("watchdog_trips_total", ""),
+                    ("stall_detections_total", "")):
         if k in stages:
             _line(f"{prefix}_{k}", float(stages[k]), unit, 1.0)
 
@@ -804,6 +838,7 @@ def _emit_probe(probe: dict) -> None:
     if "error" in probe:
         _line("backend_probe", 0.0, "", 0.0, error=probe["error"],
               probe_s=probe["probe_s"], fallback="cpu",
+              watchdog_trips=probe.get("watchdog_trips", 0),
               detail=probe.get("detail", ""))
     else:
         _line("backend_probe", probe["probe_s"], "s", 1.0,
@@ -965,6 +1000,13 @@ def chaos(seed: int) -> None:
 
 
 if __name__ == "__main__":
+    if "--probe-timeout" in sys.argv:
+        # override bench.probe-timeout for this invocation (the config
+        # key applies when a job Configuration reaches the watchdog; the
+        # probe runs before any job exists)
+        from flink_tpu.runtime.watchdog import WATCHDOG
+        i = sys.argv.index("--probe-timeout")
+        WATCHDOG.deadlines["bench.probe"] = float(sys.argv[i + 1])
     if "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:

@@ -62,13 +62,32 @@ class CheckpointStorage:
         raise NotImplementedError
 
 
-def _fire_checkpoint_write() -> None:
-    """Fault site checkpoint.write (docs/ROBUSTNESS.md): a trip fails the
-    store like a full/unreachable checkpoint volume would. The
-    coordinators treat any store failure as an aborted checkpoint — the
-    job keeps running on its previous completed checkpoint."""
+def _bounded_io(site: str, fn):
+    """Run one storage operation under the stall watchdog
+    (``watchdog.checkpoint-timeout``). The write/read is idempotent
+    (atomic publish + content-addressed chunks), so one in-place stall
+    retry is safe; a repeated stall raises StallError — which the
+    coordinators tolerate for writes exactly like any other failed
+    store, and which fails the restore (-> restart strategy) for loads.
+    Raising fault trips keep their PR-2 single-visit semantics (a failed
+    write aborts the checkpoint; it is NOT absorbed by retry)."""
+    from ..metrics.device import DEVICE_STATS
     from ..runtime.faults import FAULTS
-    FAULTS.fire("checkpoint.write")
+    from ..runtime.watchdog import WATCHDOG, StallError
+
+    def _body():
+        FAULTS.fire(site)
+        return fn()
+
+    attempt = 0
+    while True:
+        try:
+            return WATCHDOG.run(site, _body, scope="checkpoint.storage")
+        except StallError:
+            if attempt >= WATCHDOG.stall_retries:
+                raise
+            attempt += 1
+            DEVICE_STATS.note_retry(site)
 
 
 class MemoryCheckpointStorage(CheckpointStorage):
@@ -76,9 +95,11 @@ class MemoryCheckpointStorage(CheckpointStorage):
         self._store: dict[int, CompletedCheckpoint] = {}
 
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
-        _fire_checkpoint_write()
-        self._store[checkpoint.checkpoint_id] = checkpoint
-        return checkpoint
+        def _write():
+            self._store[checkpoint.checkpoint_id] = checkpoint
+            return checkpoint
+
+        return _bounded_io("checkpoint.write", _write)
 
     def discard(self, checkpoint: CompletedCheckpoint) -> None:
         self._store.pop(checkpoint.checkpoint_id, None)
@@ -344,7 +365,11 @@ class FsCheckpointStorage(CheckpointStorage):
 
     # -- storage API ---------------------------------------------------
     def store(self, checkpoint: CompletedCheckpoint) -> CompletedCheckpoint:
-        _fire_checkpoint_write()
+        return _bounded_io("checkpoint.write",
+                           lambda: self._store_inner(checkpoint))
+
+    def _store_inner(self, checkpoint: CompletedCheckpoint
+                     ) -> CompletedCheckpoint:
         d = self._path(checkpoint)
         os.makedirs(d, exist_ok=True)
         # set the path BEFORE pickling so a checkpoint load()ed from disk
@@ -407,7 +432,16 @@ class FsCheckpointStorage(CheckpointStorage):
         in place (metadata is fully usable: ids, uids, parallelism) —
         callers that substitute some tasks' snapshots from elsewhere
         (local recovery) resolve only the remainder via resolve_tasks,
-        skipping those tasks' chunk reads entirely."""
+        skipping those tasks' chunk reads entirely.
+
+        Deadline-bounded (site checkpoint.load): a restore reading from a
+        wedged checkpoint volume stalls into StallError instead of
+        freezing recovery — the restart strategy then handles it like any
+        other failed restore attempt."""
+        return _bounded_io("checkpoint.load",
+                           lambda: self._load_inner(path, resolve))
+
+    def _load_inner(self, path: str, resolve: bool) -> CompletedCheckpoint:
         meta = path if path.endswith("_metadata") else os.path.join(path,
                                                                     "_metadata")
         with open(meta, "rb") as f:

@@ -46,6 +46,7 @@ from .failover import restart_strategy_from_config
 from .resource_manager import SlotManager, build_schedule
 from ..graph.stream_graph import JobGraph
 from ..runtime.channels import InputGate, LocalChannel
+from ..runtime.watchdog import StallError, TaskStallDetector
 from ..runtime.operators.base import OperatorChain, OperatorContext
 from ..runtime.stream_task import (
     OneInputStreamTask, SourceStreamTask, StreamTask, TwoInputStreamTask,
@@ -242,13 +243,21 @@ class _Coordinator:
             pass
 
     def broadcast(self, msg: dict) -> None:
+        from ..runtime.watchdog import StallError, stall_bounded
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
-            try:
+            def _send(w=w):
                 with w.send_lock:
                     _send_msg(w.sock, msg)
-            except OSError:
+            try:
+                # deadline-bounded (site rpc.send): a worker whose socket
+                # accepts a byte per minute must not wedge the control
+                # plane — a stalled send is skipped like a severed one,
+                # and the worker's missed heartbeats finish the job
+                stall_bounded("rpc.send", _send,
+                              scope=f"coord->host{w.host_id}", retries=0)
+            except (OSError, StallError):
                 pass
 
     # -- checkpointing -----------------------------------------------------
@@ -524,7 +533,9 @@ class DistributedHost:
         subtasks of a 1-slot host)."""
         jg, config = self.jg, self.config
         from ..runtime.faults import FAULTS
+        from ..runtime.watchdog import WATCHDOG
         FAULTS.configure(config)
+        WATCHDOG.configure(config)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "
@@ -561,6 +572,9 @@ class DistributedHost:
                         channels[(ei, s, d)] = self.transport.channel(
                             edge_key(ei, s, d))
 
+        from ..core.config import WatchdogOptions
+        bp_stall = float(config.get(
+            WatchdogOptions.BACKPRESSURE_STALL_TIMEOUT))
         from ..metrics.core import TaskMetrics
         for vid, vertex in jg.vertices.items():
             out_edges = [(ei, e) for ei, e in enumerate(jg.edges)
@@ -582,7 +596,8 @@ class DistributedHost:
                     dst_par = jg.vertices[e.target_vertex].parallelism
                     w = RecordWriter(
                         [channels[(ei, sub, d)] for d in range(dst_par)],
-                        e.partitioner_factory(), sub)
+                        e.partitioner_factory(), sub,
+                        stall_timeout=bp_stall)
                     if e.side_tag is None:
                         writers.append(w)
                     else:
@@ -691,8 +706,19 @@ class DistributedHost:
         return self._config_slots([self.host_id])[self.host_id]
 
     def _ctrl_send(self, msg: dict) -> None:
-        with self._ctrl_lock:
-            _send_msg(self._ctrl, msg)
+        """Deadline-bounded control send (site rpc.send): a stalled frame
+        raises StallError, which every caller treats exactly like a
+        severed connection (OSError) — the lock is taken INSIDE the
+        supervised call, so an abandoned worker finishing a stuck sendall
+        still serializes against the next frame (no interleaving)."""
+        from ..runtime.watchdog import stall_bounded
+
+        def _send():
+            with self._ctrl_lock:
+                _send_msg(self._ctrl, msg)
+
+        stall_bounded("rpc.send", _send,
+                      scope=f"host{self.host_id}->coord", retries=0)
 
     def _max_restart_wait(self) -> float:
         """Upper bound on how long the coordinator may take to broadcast a
@@ -847,7 +873,7 @@ class DistributedHost:
                     self._cancelled.set()
                     if self.job is not None:
                         self.job.cancel()
-        except OSError:
+        except (OSError, StallError):
             pass
 
     def _heartbeat_loop(self) -> None:
@@ -868,7 +894,9 @@ class DistributedHost:
                 self._ctrl_send({"type": "heartbeat",
                                  "host_id": self.host_id,
                                  "wm_minima": minima})
-            except OSError:
+            except (OSError, StallError):
+                # a stalled control socket is a severed one: stop beating,
+                # let the coordinator's heartbeat timeout take over
                 return
             time.sleep(interval)
 
@@ -965,6 +993,10 @@ class DistributedHost:
         slots = self._config_slots(live)
         epoch, restored = 0, None
         job = None
+        detector = None
+        from ..core.config import WatchdogOptions
+        stall_timeout = float(self.config.get(
+            WatchdogOptions.TASK_STALL_TIMEOUT))
         try:
             while True:
                 self._restart_event.clear()
@@ -987,6 +1019,14 @@ class DistributedHost:
                 job = self.deploy(peer_data_addrs, live_hosts=live,
                                   epoch=epoch, restored=restored, slots=slots)
                 job.checkpoint_listener = self._make_listener()
+                # per-attempt task-progress supervision: a stalled subtask
+                # on THIS host fails its task; the failure report reaches
+                # the coordinator, which redeploys over the live hosts
+                # from the latest checkpoint — the same path a crashed
+                # task takes
+                if detector is not None:
+                    detector.stop()
+                detector = TaskStallDetector(job, stall_timeout).start()
                 self._redeploying.clear()
                 if epoch > 0 and self._ctrl is not None:
                     # announce readiness for the new attempt
@@ -1009,7 +1049,7 @@ class DistributedHost:
                                                  "host_id": self.host_id,
                                                  "epoch": epoch,
                                                  "error": str(e)})
-                            except OSError:
+                            except (OSError, StallError):
                                 raise e
                             wait_s = self._max_restart_wait()
                             if remaining() is not None:
@@ -1028,7 +1068,7 @@ class DistributedHost:
                         self._ctrl_send({"type": "finished",
                                          "host_id": self.host_id,
                                          "epoch": epoch})
-                    except OSError:
+                    except (OSError, StallError):
                         pass
                 if not restart_enabled or self._ctrl is None:
                     break
@@ -1041,6 +1081,8 @@ class DistributedHost:
                 if self._restart_intent is None:
                     break
         finally:
+            if detector is not None:
+                detector.stop()
             self._cancelled.set()
         return job
 

@@ -171,9 +171,12 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     job.metrics_registry = metrics_registry
     # arm (or disarm) the process-global fault injector from THIS job's
     # config — idempotent on an unchanged spec, so failover redeploys
-    # keep their visit counters (a once@N fault must not re-arm)
+    # keep their visit counters (a once@N fault must not re-arm) — and
+    # the stall watchdog's per-site deadlines
     from ..runtime.faults import FAULTS
+    from ..runtime.watchdog import WATCHDOG
     FAULTS.configure(config)
+    WATCHDOG.configure(config)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape
@@ -298,14 +301,21 @@ def _deploy_vertices(job: "LocalJob", job_graph: JobGraph,
                 kv_registry=job.kv_registry)
 
             # writers: one per (non-side) out edge; side writers by tag;
-            # feedback edges get the filtering writer (records only)
+            # feedback edges get the filtering writer (records only).
+            # Backpressure waits are capped (task.backpressure.stall-
+            # timeout) so a stuck-but-alive downstream peer raises
+            # StallError into the supervisor instead of wedging the task
+            from ..core.config import WatchdogOptions
             from ..runtime.writer import FeedbackRecordWriter
+            bp_stall = float(config.get(
+                WatchdogOptions.BACKPRESSURE_STALL_TIMEOUT))
             writers, side_writers = [], {}
             for ei, e in out_edges:
                 cls = FeedbackRecordWriter if e.feedback else RecordWriter
                 w = cls([channels[ei][sub][d]
                          for d in range(len(channels[ei][sub]))],
-                        e.partitioner_factory(), sub)
+                        e.partitioner_factory(), sub,
+                        stall_timeout=bp_stall)
                 if e.side_tag is None:
                     writers.append(w)
                 else:
@@ -418,10 +428,19 @@ def run_job(job_graph: JobGraph, config: Configuration,
         coordinator = CheckpointCoordinator(job, config)
         coordinator.start_periodic()
     job.coordinator = coordinator
+    # task-progress supervision: without a supervisor there is no restart
+    # path, but a stalled subtask still FAILS the job with a typed
+    # StallError instead of blocking job.wait until its timeout with
+    # zero signal
+    from ..core.config import WatchdogOptions
+    from ..runtime.watchdog import TaskStallDetector
+    detector = TaskStallDetector(
+        job, float(config.get(WatchdogOptions.TASK_STALL_TIMEOUT))).start()
     job.start()
     try:
         job.wait(timeout)
     finally:
+        detector.stop()
         if coordinator is not None:
             coordinator.stop()
     return job

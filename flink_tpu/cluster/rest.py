@@ -100,8 +100,11 @@ class RestEndpoint:
     def _exceptions(self, name: str) -> Optional[dict]:
         """Bounded failure history (the reference's JobExceptionsHandler /
         exception-history endpoint): task failures, restart decisions,
-        degradations — newest first — plus any failed checkpoint writes
-        from the coordinator's stats."""
+        degradations, stall detections — newest first — plus any failed
+        checkpoint writes from the coordinator's stats and the process-
+        global watchdog's stall events (deadline expiries absorbed by
+        retry or the degradation ladder never reach a task failure, but
+        the operator debugging a slow job still needs to see them)."""
         job = self._jobs.get(name)
         if job is None:
             return None
@@ -113,6 +116,8 @@ class RestEndpoint:
                                 "checkpoint-write-failure",
                                 "checkpoint": s.get("id"),
                                 "error": s.get("error")})
+        from ..runtime.watchdog import WATCHDOG
+        entries.extend(dict(e) for e in WATCHDOG.events)
         entries.sort(key=lambda e: e.get("timestamp") or 0, reverse=True)
         return {"name": name, "entries": entries}
 
@@ -138,11 +143,16 @@ class RestEndpoint:
 
     def _metrics_snapshot(self) -> dict:
         from ..metrics.device import DEVICE_STATS
+        from ..runtime.watchdog import PROGRESS
 
         snap = {k: v for k, v in self._metrics_registry().snapshot().items()
                 if isinstance(v, (int, float)) and not isinstance(v, bool)}
         snap.update({f"device.{k}": v
                      for k, v in DEVICE_STATS.snapshot().items()})
+        # per-task stall-supervision surface: wall-clock since each live
+        # subtask's last progress-epoch bump
+        snap.update({f"task.{tid}.last_progress_age_ms": age
+                     for tid, age in PROGRESS.ages_ms().items()})
         return snap
 
     def _trigger_savepoint(self, name: str) -> tuple[int, dict]:

@@ -46,6 +46,7 @@ class JobSupervisor:
         self.cancel_requested = False
         self.current_job: Optional[LocalJob] = None
         self.coordinator: Optional[CheckpointCoordinator] = None
+        self._detector = None  # per-attempt TaskStallDetector
         self._latest: Optional[CompletedCheckpoint] = None
         self._rescaling = False  # guards the cancel->redeploy swap window
         self.failures: list[tuple[int, str]] = []  # (attempt, error message)
@@ -68,9 +69,25 @@ class JobSupervisor:
             # keep checkpoint ids monotonically increasing across restarts
             coordinator._next_id = self._latest.checkpoint_id + 1
         coordinator.start_periodic()
+        # task-progress supervision (runtime/watchdog.py): a subtask whose
+        # epoch stalls with queued input fails with StallError, which
+        # lands in current_failures() and rides the SAME region-restart /
+        # restart-from-checkpoint flow below as any other task failure
+        from ..core.config import WatchdogOptions
+        from ..runtime.watchdog import TaskStallDetector
+        if self._detector is not None:
+            self._detector.stop()
+        self._detector = TaskStallDetector(
+            job, float(self.config.get(
+                WatchdogOptions.TASK_STALL_TIMEOUT))).start()
         self.current_job = job
         self.coordinator = coordinator
         return job
+
+    def _stop_supervision(self) -> None:
+        if self._detector is not None:
+            self._detector.stop()
+        self.coordinator.stop()
 
     def run(self, timeout: Optional[float] = 300.0,
             initial_restore: Optional[CompletedCheckpoint] = None
@@ -88,7 +105,7 @@ class JobSupervisor:
             self.attempt += 1
             job = self._deploy(restore)
             if self.cancel_requested:
-                self.coordinator.stop()
+                self._stop_supervision()
                 job.cancel()
                 return job
             job.start()
@@ -118,15 +135,15 @@ class JobSupervisor:
                         # rescale() cancelled this job but hasn't installed
                         # the replacement yet — wait for the swap
                         time.sleep(0.05)
-                self.coordinator.stop()
+                self._stop_supervision()
                 return job
             except TimeoutError:
-                self.coordinator.stop()
+                self._stop_supervision()
                 raise
             except RuntimeError as e:
                 # task failure: snapshot the latest checkpoint, consult the
                 # restart strategy, redeploy (reference maybeRestartTasks)
-                self.coordinator.stop()
+                self._stop_supervision()
                 latest = self.coordinator.latest_checkpoint()
                 if latest is not None:
                     self._latest = latest

@@ -212,6 +212,14 @@ class TaskMetrics:
                 lambda: timers.backpressured_ms_per_s)
         g.gauge("busyTimeRatio", lambda: timers.busy_ratio)
 
+    def bind_progress(self, progress) -> None:
+        """Expose the task's progress-epoch age as a gauge
+        (``lastProgressAgeMs``) — the per-task stall-supervision surface
+        the detector, REST snapshot, and dashboards all read."""
+        g = self.group
+        g.gauge("lastProgressAgeMs", lambda: progress.age_ms)
+        g.gauge("progressEpoch", lambda: progress.epoch)
+
     def operator_group(self, op_key: str) -> MetricGroup:
         """Per-operator scope under this task (WatermarkGauge / operator
         latency live here)."""

@@ -49,6 +49,10 @@ class DeviceStats:
         self._injected: dict[str, int] = {}
         self.dead_letter_records = 0
         self.dead_letter_batches = 0
+        # stall accounting (PR 3): watchdog deadline expiries per site,
+        # task-progress / backpressure stall detections per scope
+        self._watchdog_trips: dict[str, int] = {}
+        self._stalls: dict[str, int] = {}
         self._tracer = None  # optional Tracer receiving Compile spans
 
     # -- compile accounting ------------------------------------------------
@@ -103,6 +107,25 @@ class DeviceStats:
             self.dead_letter_records += int(records)
             self.dead_letter_batches += int(batches)
 
+    def note_watchdog_trip(self, site: str) -> None:
+        with self._lock:
+            self._watchdog_trips[site] = \
+                self._watchdog_trips.get(site, 0) + 1
+
+    def note_stall(self, scope: str) -> None:
+        with self._lock:
+            self._stalls[scope] = self._stalls.get(scope, 0) + 1
+
+    @property
+    def watchdog_trips(self) -> int:
+        with self._lock:
+            return sum(self._watchdog_trips.values())
+
+    @property
+    def stall_detections(self) -> int:
+        with self._lock:
+            return sum(self._stalls.values())
+
     @property
     def retries(self) -> int:
         with self._lock:
@@ -153,6 +176,8 @@ class DeviceStats:
                 "dead_letter_records_total": self.dead_letter_records,
                 "dead_letter_batches_total": self.dead_letter_batches,
                 "injected_faults_total": sum(self._injected.values()),
+                "watchdog_trips_total": sum(self._watchdog_trips.values()),
+                "stall_detections_total": sum(self._stalls.values()),
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -162,6 +187,10 @@ class DeviceStats:
                 out[f"degraded.{scope}"] = n
             for site, n in sorted(self._injected.items()):
                 out[f"injected.{site}"] = n
+            for site, n in sorted(self._watchdog_trips.items()):
+                out[f"watchdog.{site}"] = n
+            for scope, n in sorted(self._stalls.items()):
+                out[f"stalls.{scope}"] = n
             return out
 
     def reset(self) -> None:
@@ -174,6 +203,8 @@ class DeviceStats:
             self._retries.clear()
             self._degraded.clear()
             self._injected.clear()
+            self._watchdog_trips.clear()
+            self._stalls.clear()
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -234,15 +265,21 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
     def deco(builder: Callable):
         @functools.lru_cache(maxsize=maxsize)
         def build(*args, **kwargs):
-            # the device.compile fault site covers EVERY instrumented
-            # builder (device_window/device_session/device_group_agg/
-            # pallas_topk/tpu_backend) at the one place a compile is
-            # decided; transient trips retry, persistent ones surface to
-            # the caller's DeviceGuard / failover
-            from ..runtime.faults import fire_with_retries
-            fire_with_retries("device.compile", scope=scope)
-            DEVICE_STATS.note_build(scope)
-            return _TimedProgram(builder(*args, **kwargs), scope)
+            # the device.compile fault site + watchdog deadline cover
+            # EVERY instrumented builder (device_window/device_session/
+            # device_group_agg/pallas_topk/tpu_backend) at the one place
+            # a compile is decided; transient trips retry, hang trips
+            # stall into the watchdog's deadline, persistent failures
+            # surface to the caller's DeviceGuard / failover
+            from ..runtime.watchdog import WATCHDOG
+
+            def _build():
+                from ..runtime.faults import fire_with_retries
+                fire_with_retries("device.compile", scope=scope)
+                DEVICE_STATS.note_build(scope)
+                return _TimedProgram(builder(*args, **kwargs), scope)
+
+            return WATCHDOG.run("device.compile", _build, scope=scope)
 
         @functools.wraps(builder)
         def wrapper(*args, **kwargs):
@@ -281,3 +318,7 @@ def bind_device_metrics(registry) -> None:
     g.gauge("dead_letter_records_total", lambda: s.dead_letter_records)
     g.gauge("dead_letter_batches_total", lambda: s.dead_letter_batches)
     g.gauge("injected_faults_total", lambda: s.injected_faults)
+    # stall supervision (prometheus: flink_tpu_device_watchdog_trips_total
+    # / flink_tpu_device_stall_detections_total)
+    g.gauge("watchdog_trips_total", lambda: s.watchdog_trips)
+    g.gauge("stall_detections_total", lambda: s.stall_detections)

@@ -97,9 +97,12 @@ def masked_topk_pallas(values: jax.Array, valid: jax.Array, k: int,
             or jnp.issubdtype(jnp.asarray(values).dtype, jnp.floating)):
         return masked_topk(values, valid, k, value_bits)
     passes = max(1, -(-value_bits // 8))
-    from ..runtime.faults import fire_with_retries
-    fire_with_retries("device.execute", scope="pallas_topk")
-    return _topk_program(int(k), int(passes), bool(interpret))(values, valid)
+    from ..runtime.watchdog import stall_bounded
+    return stall_bounded(
+        "device.execute",
+        lambda: _topk_program(int(k), int(passes),
+                              bool(interpret))(values, valid),
+        scope="pallas_topk")
 
 
 @instrumented_program_cache("ops.pallas_topk", maxsize=32)

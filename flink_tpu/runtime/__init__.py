@@ -5,3 +5,6 @@ from .faults import (  # noqa: F401
 )
 from .harness import OneInputOperatorTestHarness  # noqa: F401
 from .timers import InternalTimerService, Timer  # noqa: F401
+from .watchdog import (  # noqa: F401
+    StallError, TaskStallDetector, WATCHDOG, Watchdog, stall_bounded,
+)

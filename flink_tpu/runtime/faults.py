@@ -19,8 +19,16 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     channel.send      writing into a downstream channel
     channel.backpressure  drop-style: a put reports "queue full" once
     checkpoint.write  persisting a completed checkpoint
+    checkpoint.load   reading a checkpoint back for restore
     rpc.heartbeat     drop-style: a worker heartbeat frame is lost
+    rpc.send          a worker<->coordinator control frame send
     sink.invoke       delivering a batch to a sink function/writer
+    bench.probe       the bench backend-availability probe
+
+Every rule also accepts a ``!hang@MS`` flag: the trip SLEEPS MS
+milliseconds at the site instead of raising — the deterministic stand-in
+for a wedged call, surfaced by the stall watchdog's per-site deadline
+(runtime/watchdog.py) rather than by an exception.
 
 ``DeviceGuard`` is the reflex around every compiled-segment call:
 transient failures retry with exponential backoff (reusing the
@@ -39,8 +47,9 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-__all__ = ["FAULT_SITES", "InjectedFault", "DeviceSegmentError",
-           "FaultInjector", "FAULTS", "fire_with_retries", "DeviceGuard"]
+__all__ = ["FAULT_SITES", "InjectedFault", "HangAbandoned",
+           "DeviceSegmentError", "FaultInjector", "FAULTS",
+           "fire_with_retries", "DeviceGuard"]
 
 #: Every site the runtime threads. ``configure`` rejects unknown sites so a
 #: typo in a chaos spec fails loudly instead of silently injecting nothing.
@@ -48,23 +57,37 @@ FAULT_SITES = (
     "device.compile", "device.execute",
     "transfer.h2d", "transfer.d2h",
     "channel.send", "channel.backpressure",
-    "checkpoint.write", "rpc.heartbeat", "sink.invoke",
+    "checkpoint.write", "checkpoint.load",
+    "rpc.heartbeat", "rpc.send", "sink.invoke",
+    "bench.probe",
 )
 
 
 class InjectedFault(RuntimeError):
-    """Raised (or reported, for drop-style sites) by a tripped fault rule."""
+    """Raised (or reported, for drop-style sites) by a tripped fault rule.
+    ``hang_ms > 0`` marks a hang fault: the site SLEEPS instead of
+    raising (the deterministic stand-in for a wedged device call — the
+    stall watchdog's deadline, not this exception, is what surfaces)."""
 
     def __init__(self, site: str, visit: int, transient: bool = True,
-                 poison: bool = False):
+                 poison: bool = False, hang_ms: int = 0):
         super().__init__(
             f"injected fault at {site} (visit {visit}, "
             f"{'transient' if transient else 'persistent'}"
-            f"{', poison' if poison else ''})")
+            f"{', poison' if poison else ''}"
+            f"{f', hang {hang_ms}ms' if hang_ms else ''})")
         self.site = site
         self.visit = visit
         self.transient = transient
         self.poison = poison
+        self.hang_ms = hang_ms
+
+
+class HangAbandoned(RuntimeError):
+    """An injected hang outlived its watchdog deadline: the caller was
+    already handed a StallError, so the abandoned worker unwinds through
+    this WITHOUT executing the real operation (exactly-once: nothing the
+    caller will retry can also run to completion here)."""
 
 
 class DeviceSegmentError(RuntimeError):
@@ -90,6 +113,7 @@ class FaultRule:
     p: float = 0.0       # prob mode: per-visit trip probability
     transient: bool = True
     poison: bool = False
+    hang_ms: int = 0     # >0: the trip SLEEPS this long instead of raising
 
     @staticmethod
     def parse(entry: str) -> "FaultRule":
@@ -103,12 +127,20 @@ class FaultRule:
                              f"(known: {', '.join(FAULT_SITES)})")
         parts = mode.strip().split("!")
         mode, flags = parts[0].strip(), {f.strip() for f in parts[1:]}
+        hang_ms = 0
+        for f in list(flags):
+            if f.startswith("hang@"):
+                flags.discard(f)
+                hang_ms = int(f[5:])
+                if hang_ms < 1:
+                    raise ValueError(
+                        f"fault rule {entry!r}: hang@MS needs MS>=1")
         bad = flags - {"persistent", "transient", "poison"}
         if bad:
             raise ValueError(f"fault rule {entry!r}: unknown flags {bad}")
         rule = FaultRule(site, "off",
                          transient="persistent" not in flags,
-                         poison="poison" in flags)
+                         poison="poison" in flags, hang_ms=hang_ms)
         if mode in ("off", ""):
             rule.mode = "off"
         elif mode == "always":
@@ -248,27 +280,58 @@ class FaultInjector:
             if len(self.events) < 4096:
                 self.events.append({"site": site, "visit": visit,
                                     "transient": rule.transient,
-                                    "poison": rule.poison})
+                                    "poison": rule.poison,
+                                    "hang_ms": rule.hang_ms})
         from ..metrics.device import DEVICE_STATS
         DEVICE_STATS.note_injected(site)
         return InjectedFault(site, visit, transient=rule.transient,
-                             poison=rule.poison)
+                             poison=rule.poison, hang_ms=rule.hang_ms)
+
+    def _hang(self, fault: InjectedFault) -> None:
+        """Sleep out a hang trip OUTSIDE the injector lock, in small
+        slices that watch the watchdog abandonment flag: once the caller
+        gave up on this worker, the real operation behind the site must
+        never run (exactly-once under stall-retry)."""
+        from .watchdog import current_call_abandoned
+
+        end = time.monotonic() + fault.hang_ms / 1000.0
+        while True:
+            if current_call_abandoned():
+                raise HangAbandoned(
+                    f"hang at {fault.site} abandoned by the watchdog")
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.005))
 
     def fire(self, site: str) -> None:
-        """Visit a raising site; raises InjectedFault when its rule trips."""
+        """Visit a raising site; raises InjectedFault when its rule trips.
+        A hang trip sleeps instead (the stall, not an exception, IS the
+        fault — the watchdog deadline is what surfaces it)."""
         if not self.enabled:
             return
         fault = self._trip(site)
-        if fault is not None:
-            raise fault
+        if fault is None:
+            return
+        if fault.hang_ms:
+            self._hang(fault)
+            return
+        raise fault
 
     def check(self, site: str) -> bool:
         """Visit a drop-style site (lost heartbeat, full queue): returns
         True when the rule trips — the caller drops/declines instead of
-        raising."""
+        raising. Hang trips sleep and report not-tripped (the delay is
+        the fault)."""
         if not self.enabled:
             return False
-        return self._trip(site) is not None
+        fault = self._trip(site)
+        if fault is None:
+            return False
+        if fault.hang_ms:
+            self._hang(fault)
+            return False
+        return True
 
     # -- views -----------------------------------------------------------
     def snapshot(self) -> dict:
@@ -351,6 +414,7 @@ class DeviceGuard:
             initial=initial, maximum=maximum, reset_after=60.0)
         self.retries = 0      # per-guard observability (bench/tests)
         self.failures = 0
+        self.stalls = 0       # watchdog deadline expiries seen here
 
     def _sites_ok(self, sites: tuple) -> None:
         for s in sites:
@@ -358,18 +422,33 @@ class DeviceGuard:
 
     def run(self, fn: Callable, sites: tuple = ("device.execute",)):
         """Call ``fn`` (which performs the guarded upload+dispatch) after
-        visiting ``sites``. Retries transient failures; raises
-        DeviceSegmentError beyond that."""
+        visiting ``sites``, the whole attempt deadline-bounded by the
+        stall watchdog (site ``device.execute``). Retries transient
+        failures AND stalls; raises DeviceSegmentError beyond that — so
+        repeated stalls at one segment walk the same degradation ladder
+        as repeated failures (evacuate + CPU-fallback pin)."""
         if not self.active:
             return fn()
+        from .watchdog import WATCHDOG, StallError
+
+        def attempt_call():
+            self._sites_ok(sites)
+            return fn()
+
         attempt = 0
         while True:
             try:
-                self._sites_ok(sites)
-                out = fn()
+                out = WATCHDOG.run("device.execute", attempt_call,
+                                   scope=self.scope)
                 if attempt:
                     self._strategy.notify_recovered()
                 return out
+            except StallError as e:
+                # a stall is transient first: the abandoned worker never
+                # ran the real dispatch (hang sleeps check abandonment),
+                # so re-running it cannot double-fold
+                self.stalls += 1
+                err, retryable = e, True
             except InjectedFault as e:
                 if e.poison:
                     self.failures += 1

@@ -434,27 +434,39 @@ class DeviceSessionWindowOperator(OneInputOperator):
             return np.concatenate([a, np.full(P - n, fill, a.dtype)])
 
         sig = self._fold_sig()
-        cols = {f: jnp.asarray(pad(np.asarray(batch.column(f))))
-                for _k, _n, f in sig}
-        dkeys = jnp.asarray(pad(keys))
-        dts = jnp.asarray(pad(ts, _NEG))
-        from ..faults import fire_with_retries
-        fire_with_retries("transfer.h2d", scope="device_session")
+        from ..watchdog import stall_bounded
+
+        def upload():
+            return ({f: jnp.asarray(pad(np.asarray(batch.column(f))))
+                     for _k, _n, f in sig},
+                    jnp.asarray(pad(keys)), jnp.asarray(pad(ts, _NEG)))
+
+        # deadline-bounded sites (docs/ROBUSTNESS.md): the upload and the
+        # materialization are idempotent (stall-retried in place); the
+        # step dispatch visits its fault site INSIDE the supervised call,
+        # so an injected hang abandoned by the watchdog never reaches the
+        # donating program (exactly-once under stall-retry)
+        cols, dkeys, dts = stall_bounded("transfer.h2d", upload,
+                                         scope="device_session")
         DEVICE_STATS.note_h2d(
             pytree_nbytes(cols) + dkeys.nbytes + dts.nbytes, n)
-        fire_with_retries("device.execute", scope="device_session")
-        step = _sess_step(sig, self._lanes, self._gap,
-                          self._backend.dirty_block_size)
-        planes = {n_: self._backend.get_array(n_)
-                  for n_ in self._plane_names()}
+
+        def dispatch():
+            step = _sess_step(sig, self._lanes, self._gap,
+                              self._backend.dirty_block_size)
+            planes = {n_: self._backend.get_array(n_)
+                      for n_ in self._plane_names()}
+            return step(
+                self._backend.table, planes,
+                self._backend.get_array("__cur_lane__"),
+                self._backend.dropped_device, self._late_dev,
+                self._backend.dirty_mask,
+                dkeys, dts, cols,
+                np.int64(n), np.int64(self._fired_boundary))
+
         (table, out, cur_lane, dropped, late, dirty,
-         n_emit, ekey, estart, eend, ecount, evals) = step(
-            self._backend.table, planes,
-            self._backend.get_array("__cur_lane__"),
-            self._backend.dropped_device, self._late_dev,
-            self._backend.dirty_mask,
-            dkeys, dts, cols,
-            np.int64(n), np.int64(self._fired_boundary))
+         n_emit, ekey, estart, eend, ecount, evals) = stall_bounded(
+            "device.execute", dispatch, scope="device_session")
         self._backend.table = table
         for n_, arr in out.items():
             self._backend.set_array(n_, arr)
@@ -462,12 +474,14 @@ class DeviceSessionWindowOperator(OneInputOperator):
         self._backend._dropped = dropped
         g = int(jax.device_get(n_emit))
         if g:
-            fire_with_retries("transfer.d2h", scope="device_session")
             span = min(pow2_ceil(g), P)
-            host = jax.device_get(
-                {"k": ekey[:span], "s": estart[:span], "e": eend[:span],
-                 "c": ecount[:span],
-                 "v": {n_: v[:span] for n_, v in evals.items()}})
+            host = stall_bounded(
+                "transfer.d2h",
+                lambda: jax.device_get(
+                    {"k": ekey[:span], "s": estart[:span],
+                     "e": eend[:span], "c": ecount[:span],
+                     "v": {n_: v[:span] for n_, v in evals.items()}}),
+                scope="device_session")
             DEVICE_STATS.note_d2h(pytree_nbytes(host), g)
             chunk = {kk: np.asarray(vv)[:g] for kk, vv in host.items()
                      if kk != "v"}
@@ -520,14 +534,20 @@ class DeviceSessionWindowOperator(OneInputOperator):
         if not self._registered:
             return
         t0 = time.perf_counter()
-        from ..faults import fire_with_retries
-        fire_with_retries("device.execute", scope="device_session")
+        from ..watchdog import stall_bounded
         fire = _sess_fire(self._agg_sig(), self._lanes, self._gap)
         while True:
             planes = {n_: self._backend.get_array(n_)
                       for n_ in self._plane_names()}
-            new, keys, start, end, outs, fired, overflow = fire(
-                self._backend.table, planes, np.int64(boundary))
+            # each fire dispatch is a deadline-bounded device.execute
+            # visit (hang trips abandoned by the watchdog never reach
+            # the program; a stalled dispatch retries once, then fails
+            # the task into restart-from-checkpoint)
+            new, keys, start, end, outs, fired, overflow = stall_bounded(
+                "device.execute",
+                lambda: fire(self._backend.table, planes,
+                             np.int64(boundary)),
+                scope="device_session")
             fired_h, overflow_h = map(int, jax.device_get(
                 (fired, overflow)))
             if fired_h == 0:
@@ -535,9 +555,12 @@ class DeviceSessionWindowOperator(OneInputOperator):
             for n_, arr in new.items():
                 self._backend.set_array(n_, arr)
             span = min(pow2_ceil(fired_h), self._backend.capacity)
-            host = jax.device_get(
-                {"k": keys[:span], "s": start[:span], "e": end[:span],
-                 "o": {n_: v[:span] for n_, v in outs.items()}})
+            host = stall_bounded(
+                "transfer.d2h",
+                lambda: jax.device_get(
+                    {"k": keys[:span], "s": start[:span], "e": end[:span],
+                     "o": {n_: v[:span] for n_, v in outs.items()}}),
+                scope="device_session")
             DEVICE_STATS.note_d2h(pytree_nbytes(host), fired_h)
             self._emit(host, fired_h)
             if overflow_h == 0:

@@ -40,6 +40,7 @@ from ...metrics.device import DEVICE_STATS, instrumented_program_cache, \
     pytree_nbytes
 from ..faults import DeviceGuard, DeviceSegmentError, FAULTS, \
     fire_with_retries
+from ..watchdog import WATCHDOG, stall_bounded
 from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, \
     sanitize_keys_device
 from ...state.tpu_backend import TpuKeyedStateBackend
@@ -477,16 +478,22 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
                 and self._backend.hbm_budget > 0)
 
     def _to_device_batch(self, batch: RecordBatch) -> DeviceRecordBatch:
-        cols = {self._key_column: jnp.asarray(
-            batch.column(self._key_column).astype(np.int64))}
-        for a in self._aggs:
-            if a.field is not None and a.field not in cols:
-                cols[a.field] = jnp.asarray(batch.column(a.field))
+        ts = batch.timestamps
+
+        def upload():
+            cols = {self._key_column: jnp.asarray(
+                batch.column(self._key_column).astype(np.int64))}
+            for a in self._aggs:
+                if a.field is not None and a.field not in cols:
+                    cols[a.field] = jnp.asarray(batch.column(a.field))
+            return cols, jnp.asarray(ts)
+
+        # deadline-bounded idempotent upload (pure function of host data:
+        # a stall-abandoned attempt re-runs safely)
+        cols, dts = stall_bounded("transfer.h2d", upload,
+                                  scope="device_window")
         schema = Schema([(f.name, f.dtype) for f in batch.schema.fields
                          if f.name in cols])
-        ts = batch.timestamps
-        dts = jnp.asarray(ts)
-        fire_with_retries("transfer.h2d", scope="device_window")
         DEVICE_STATS.note_h2d(pytree_nbytes(cols) + dts.nbytes, batch.n)
         return DeviceRecordBatch(schema, cols, dts,
                                  int(ts.min()), int(ts.max()))
@@ -690,9 +697,12 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         # the slice program compiles O(log S) times, not once per count
         span = min(1 << (take - 1).bit_length() if take > 1 else 1,
                    self._stage_slots)
-        fire_with_retries("transfer.d2h", scope="device_window")
-        host = jax.device_get({k: v[:span] for k, v in self._stage.items()
-                               if k != "count"})
+        host = stall_bounded(
+            "transfer.d2h",
+            lambda: jax.device_get({k: v[:span]
+                                    for k, v in self._stage.items()
+                                    if k != "count"}),
+            scope="device_window")
         DEVICE_STATS.note_d2h(pytree_nbytes(host), take)
         keys = np.asarray(host["keys"])[:take]
         ring = np.asarray(host["ring"])[:take]
@@ -776,10 +786,20 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
     def _admit_token(self, token) -> None:
         """Bounded in-flight window shared by the device and native ingest
         paths: block on the (k - max_inflight)th step's completion token
-        before admitting more work, then drain any landed fires."""
+        before admitting more work, then drain any landed fires. The wait
+        is deadline-bounded: a dispatch that never retires (wedged chip)
+        raises StallError into task failover instead of blocking the
+        mailbox loop forever — its state futures are unresolvable, so
+        restart-from-checkpoint is the only sound rung for this stall."""
         self._inflight.append(token)
         if len(self._inflight) > self._max_inflight:
-            jax.block_until_ready(self._inflight.popleft())
+            tok = self._inflight.popleft()
+            if self._guard is not None and self._guard.active:
+                WATCHDOG.run("device.execute",
+                             lambda: jax.block_until_ready(tok),
+                             scope="device_window.inflight")
+            else:
+                jax.block_until_ready(tok)
             if self._pending:
                 self._drain(block=False)
 
@@ -827,8 +847,10 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
             else:
                 rows.append(col.astype(np.int64))
                 col_meta.append((name, False))
-        fire_with_retries("transfer.h2d", scope="device_window")
-        buf = jnp.asarray(np.stack(rows))          # the ONE upload
+        packed = np.stack(rows)
+        buf = stall_bounded("transfer.h2d",
+                            lambda: jnp.asarray(packed),  # the ONE upload
+                            scope="device_window")
         DEVICE_STATS.note_h2d(buf.nbytes, batch.n)
         slots = self._backend.slots_for_batch_device(buf[0])
         dring = buf[1]
@@ -929,8 +951,13 @@ class DeviceWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         t_drain = time.perf_counter()
         p_end, outs, host_part, t0 = item
         if self._guard is None or self._guard.active:
-            fire_with_retries("transfer.d2h", scope="device_window")
-        host = jax.device_get(outs)       # ONE transfer for everything
+            # ONE deadline-bounded transfer for everything (device_get is
+            # idempotent: a stall-abandoned read re-runs safely)
+            host = stall_bounded("transfer.d2h",
+                                 lambda: jax.device_get(outs),
+                                 scope="device_window")
+        else:
+            host = jax.device_get(outs)   # degraded: host buffers, a view
         d2h_bytes = pytree_nbytes(host)
         if self._topk is not None:
             keys_k, ok, results, dropped, occ = host

@@ -168,9 +168,17 @@ class StreamTask:
         self.operator_state = OperatorStateBackend()
         self._last_proc_time = 0
         self.io_timers = TaskIOTimers()
+        # per-subtask progress epoch (stall supervision, runtime/
+        # watchdog.py): the loop bumps it once per processed event; the
+        # job-level TaskStallDetector flags a stale epoch with queued
+        # input and routes the task into the restart path
+        from .watchdog import TaskProgress
+        self.progress = TaskProgress()
         metrics = getattr(ctx, "metrics", None)
         if metrics is not None and hasattr(metrics, "bind_io_timers"):
             metrics.bind_io_timers(self.io_timers)
+        if metrics is not None and hasattr(metrics, "bind_progress"):
+            metrics.bind_progress(self.progress)
 
     def all_writers(self):
         yield from self.writers
@@ -219,7 +227,10 @@ class StreamTask:
         return self._thread is not None and self._thread.is_alive()
 
     def _run_safely(self) -> None:
+        from .watchdog import PROGRESS
         self.io_timers.start()
+        self.progress.bump()  # deploy->start latency never reads as a stall
+        PROGRESS.register(self.task_id, self.progress)
         try:
             self.invoke()
             self.reporter.task_finished(self.task_id)
@@ -228,9 +239,17 @@ class StreamTask:
                 self.reporter.task_failed(self.task_id, e)
         finally:
             self.io_timers.stop()
+            PROGRESS.unregister(self.task_id)
 
     def invoke(self) -> None:
         raise NotImplementedError
+
+    def input_pending(self) -> bool:
+        """Queued input this task COULD be processing right now — the
+        stall detector's 'stalled, not idle' discriminator. Sources have
+        no gate and are never flagged (a quiet source is idle by
+        definition; its blocking sites are watchdogged individually)."""
+        return False
 
     # -- helpers -----------------------------------------------------------
     def _advance_processing_time(self, chain: Optional[OperatorChain]) -> None:
@@ -377,6 +396,7 @@ class SourceStreamTask(StreamTask):
                 emit_dt = time.perf_counter() - t0
                 self.stage_s["emit"] += emit_dt
                 self.io_timers.busy_s += emit_dt
+                self.progress.bump()
                 if adaptive:
                     # desired = throughput x target; EMA toward it. At the
                     # fixpoint one batch takes exactly target seconds.
@@ -562,6 +582,7 @@ class TwoInputStreamTask(StreamTask):
             elif ev.kind == "idle":
                 self.broadcast_all(ev.value)
             self.io_timers.busy_s += time.perf_counter() - t0
+            self.progress.bump()
             self._advance_processing_time(self.chain)
 
         if not self._cancelled.is_set():
@@ -569,6 +590,9 @@ class TwoInputStreamTask(StreamTask):
             self.chain.finish()
             self.chain.close()
             self.broadcast_all(EndOfInput())
+
+    def input_pending(self) -> bool:
+        return any(ch.size() > 0 for g in self.gates for ch in g.channels)
 
 
 class OneInputStreamTask(StreamTask):
@@ -661,6 +685,7 @@ class OneInputStreamTask(StreamTask):
             elif ev.kind == "idle":
                 self.broadcast_all(ev.value)
             self.io_timers.busy_s += time.perf_counter() - t0
+            self.progress.bump()
             self._maybe_finish_unaligned()
             self._advance_processing_time(self.chain)
 
@@ -669,3 +694,6 @@ class OneInputStreamTask(StreamTask):
             self.chain.finish()
             self.chain.close()
             self.broadcast_all(EndOfInput())
+
+    def input_pending(self) -> bool:
+        return any(ch.size() > 0 for ch in self.gate.channels)

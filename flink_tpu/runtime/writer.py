@@ -155,14 +155,23 @@ class WriterCancelled(Exception):
 
 
 class RecordWriter:
-    """Writes one operator output to its downstream channels."""
+    """Writes one operator output to its downstream channels.
+
+    ``stall_timeout`` caps the TOTAL time one element may spend blocked
+    on a full downstream channel (``task.backpressure.stall-timeout``):
+    a stuck-but-alive peer — one that holds the connection open but never
+    drains — then raises :class:`StallError` into the supervisor instead
+    of wedging this task forever. The element is never dropped: the task
+    fails, and restart-from-checkpoint replays it."""
 
     def __init__(self, channels: list[Channel], partitioner: StreamPartitioner,
-                 subtask_index: int, put_timeout: float = 0.1):
+                 subtask_index: int, put_timeout: float = 0.1,
+                 stall_timeout: float = 0.0):
         self.channels = channels
         self.partitioner = partitioner
         self.subtask_index = subtask_index
         self._put_timeout = put_timeout
+        self.stall_timeout = stall_timeout  # 0 = unbounded wait
         self.cancel_event = None  # set by the task that owns this writer
         self.io_timers = None     # set by the task: backpressure accounting
 
@@ -178,6 +187,14 @@ class RecordWriter:
                 if (self.cancel_event is not None
                         and self.cancel_event.is_set()):
                     raise WriterCancelled()
+                if (self.stall_timeout
+                        and time.perf_counter() - t0 > self.stall_timeout):
+                    from ..metrics.device import DEVICE_STATS
+                    from .watchdog import StallError
+                    DEVICE_STATS.note_stall("channel.backpressure")
+                    raise StallError("channel.backpressure",
+                                     self.stall_timeout,
+                                     scope=f"subtask {self.subtask_index}")
         finally:
             if self.io_timers is not None:
                 self.io_timers.backpressured_s += time.perf_counter() - t0

@@ -222,36 +222,49 @@ class DeviceGroupAggOperator(OneInputOperator):
                 return a
             return np.concatenate([a, np.full(pad, fill, a.dtype)])
 
-        from ..runtime.faults import fire_with_retries
-        vals = tuple(jnp.asarray(_padded(
-            np.asarray(batch.column(c), np.float64), 0.0))
-            for c in col_names)
-        fire_with_retries("transfer.h2d", scope="device_group_agg")
+        # deadline-bounded sites (runtime/watchdog.py): idempotent upload
+        # and materialization stall-retry in place; the step dispatch
+        # visits its fault site inside the supervised call so an injected
+        # hang abandoned by the watchdog never reaches the donating
+        # program
+        from ..runtime.watchdog import stall_bounded
+        vals = stall_bounded(
+            "transfer.h2d",
+            lambda: tuple(jnp.asarray(_padded(
+                np.asarray(batch.column(c), np.float64), 0.0))
+                for c in col_names),
+            scope="device_group_agg")
         DEVICE_STATS.note_h2d(pytree_nbytes(vals) + P * 8, n)  # vals + sign
         # pads alias the first real key: no new table slots, and the
         # program's n_valid mask keeps them out of every fold
         slots = self._backend.slots_for_batch(_padded(keys, keys[0]))
-        fire_with_retries("device.execute", scope="device_group_agg")
-        step = _gagg_program(tuple(fold_sig),
-                             self._backend.dirty_block_size)
-        planes = {"__rc__": self._backend.get_array("__rc__")}
-        for name, _k, _f in self._plane_sig:
-            planes[name] = self._backend.get_array(name)
-        out, dirty, n_groups, row_idx, comp_prev, comp_new = step(
-            planes, self._backend.dirty_mask, slots,
-            jnp.asarray(_padded(sign, 0.0)), vals, np.int64(n))
+
+        def dispatch():
+            step = _gagg_program(tuple(fold_sig),
+                                 self._backend.dirty_block_size)
+            planes = {"__rc__": self._backend.get_array("__rc__")}
+            for name, _k, _f in self._plane_sig:
+                planes[name] = self._backend.get_array(name)
+            return step(
+                planes, self._backend.dirty_mask, slots,
+                jnp.asarray(_padded(sign, 0.0)), vals, np.int64(n))
+
+        out, dirty, n_groups, row_idx, comp_prev, comp_new = stall_bounded(
+            "device.execute", dispatch, scope="device_group_agg")
         for n, arr in out.items():
             self._backend.set_array(n, arr)
         self._backend.set_dirty_mask(dirty)
         g = int(jax.device_get(n_groups))
         if g == 0:
             return
-        fire_with_retries("transfer.d2h", scope="device_group_agg")
         span = min(1 << (g - 1).bit_length() if g > 1 else 1, P)
-        host = jax.device_get({
-            "idx": row_idx[:span],
-            "prev": {n: v[:span] for n, v in comp_prev.items()},
-            "new": {n: v[:span] for n, v in comp_new.items()}})
+        host = stall_bounded(
+            "transfer.d2h",
+            lambda: jax.device_get({
+                "idx": row_idx[:span],
+                "prev": {n: v[:span] for n, v in comp_prev.items()},
+                "new": {n: v[:span] for n, v in comp_new.items()}}),
+            scope="device_group_agg")
         DEVICE_STATS.note_d2h(pytree_nbytes(host), g)
         self._emit_changelog(batch, key_cols, host, g)
 

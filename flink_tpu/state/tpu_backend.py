@@ -442,11 +442,27 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def _sync_mirror(self) -> None:
         """Bring the host mirror up to date with device state, transferring
         only dirty blocks (plus any state registered since the mirror was
-        built). Tracks the DMA bytes of this capture."""
+        built). Tracks the DMA bytes of this capture.
+
+        Deadline-bounded (fault site transfer.d2h; the deadline is the
+        CHECKPOINT timeout — this is a bulk snapshot-path capture, not a
+        per-batch transfer — and there is no in-place retry: the mirror
+        update mutates self, so a stall propagates as StallError — a
+        wedged snapshot capture then fails the checkpoint/evacuation
+        instead of freezing it, and recovery rides the restart path)."""
+        from ..runtime.watchdog import WATCHDOG
+
+        def _capture():
+            from ..runtime.faults import fire_with_retries
+            fire_with_retries("transfer.d2h", scope="tpu_backend.snapshot")
+            self._sync_mirror_inner()
+
+        WATCHDOG.run("transfer.d2h", _capture, scope="tpu_backend.snapshot",
+                     deadline=WATCHDOG.deadline_for("checkpoint.write"))
+
+    def _sync_mirror_inner(self) -> None:
         nb, bs = self._n_blocks, self._block
         self.last_snapshot_dma_bytes = 0
-        from ..runtime.faults import fire_with_retries
-        fire_with_retries("transfer.d2h", scope="tpu_backend.snapshot")
         if self._mirror is None:
             # writable copies: device_get may return read-only views
             t = np.array(jax.device_get(self.table))
@@ -1062,6 +1078,21 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 "max_parallelism": self.max_parallelism, "states": states}
 
     def restore(self, snapshots: Iterable[dict]) -> None:
+        """Deadline-bounded (fault site transfer.h2d; the deadline is the
+        CHECKPOINT timeout — a restore is a bulk state rebuild, not a
+        per-batch transfer — and there is no in-place retry: the rebuild
+        mutates self in stages, so a stalled restore upload raises
+        StallError into the restart path rather than freezing recovery
+        mid-rebuild)."""
+        from ..runtime.watchdog import WATCHDOG
+
+        snapshots = list(snapshots)
+        WATCHDOG.run("transfer.h2d",
+                     lambda: self._restore_inner(snapshots),
+                     scope="tpu_backend.restore",
+                     deadline=WATCHDOG.deadline_for("checkpoint.load"))
+
+    def _restore_inner(self, snapshots: Iterable[dict]) -> None:
         all_keys, per_state_vals = [], {}
         state_meta: dict[str, dict] = {}
         for snap in snapshots:

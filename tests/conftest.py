@@ -21,6 +21,32 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _stall_wall_clock_guard(request):
+    """Hard per-test wall-clock guard for `stall`-marked tests: the stall
+    watchdog's own regressions must FAIL the suite, not hang it. SIGALRM
+    fires in the main thread and unwinds whatever wait the test is
+    blocked in (hang injections use <=50ms delays, so 120s means a real
+    supervision bug, not a slow box)."""
+    if request.node.get_closest_marker("stall") is None:
+        yield
+        return
+    import signal
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            "stall test exceeded its 120s wall-clock guard — the stall "
+            "watchdog failed to bound a hang")
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(120)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 @pytest.fixture
 def eight_device_mesh():
     from jax.sharding import Mesh

@@ -5,6 +5,8 @@ accounting. All fast enough for tier-1 (the `chaos` marker selects them
 for dedicated runs; `python bench.py --chaos SEED` drives the same
 schedule through the full tiny-Q5 stage)."""
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -31,9 +33,13 @@ PANE = 1000
 
 @pytest.fixture(autouse=True)
 def _clean_injector():
+    from flink_tpu.runtime.watchdog import WATCHDOG
+
     faults_mod.FAULTS.reset()
+    WATCHDOG.reset()
     yield
     faults_mod.FAULTS.reset()
+    WATCHDOG.reset()
 
 
 def _chaos_config(spec: str, seed: int = 0) -> Configuration:
@@ -86,13 +92,16 @@ def _expected(keys, vals, ts, skip=()) -> dict:
 def _run_device_trial(spec: str, seed: int, data_seed: int = 0,
                       batches: int = 6, batch_n: int = 256,
                       config: Configuration = None,
-                      device_batches: bool = True):
+                      device_batches: bool = True, defer: bool = None):
     """Drive the device window operator through the harness; returns
     (emitted dict, operator, raw data)."""
+    from flink_tpu.runtime.watchdog import WATCHDOG
+
     cfg = config if config is not None else _chaos_config(spec, seed)
-    op = _make_op(defer_overflow=device_batches)
+    op = _make_op(defer_overflow=device_batches if defer is None else defer)
     h = OneInputOperatorTestHarness(op, SCHEMA, config=cfg)
     faults_mod.FAULTS.configure(cfg)
+    WATCHDOG.configure(cfg)  # harness path: adopt deadlines like deploy does
     keys, vals, ts = _gen(data_seed, batches * batch_n)
     for b in range(batches):
         sl = slice(b * batch_n, (b + 1) * batch_n)
@@ -233,6 +242,172 @@ def test_validate_batches_quarantines_nonfinite_rows():
     assert rows == {1: 1.0, 2: 2.0}
     # the poisoned rows surface on the dead-letter side output
     assert len(h.get_side_output("dead-letter")) == 2
+
+
+# ---------------------------------------------------------------------------
+# stall chaos: !hang injection at every watchdog site (PR 3)
+# ---------------------------------------------------------------------------
+
+#: which WatchdogOptions deadline guards each injected site — the test
+#: tightens ONLY the site under trial: real work at the other sites (XLA
+#: compiles inside a first dispatch, bulk restore captures) must keep
+#: their generous defaults or it would stall spuriously
+_SITE_DEADLINE_KEY = {
+    "device.compile": "watchdog.device.compile-timeout",
+    "device.execute": "watchdog.device.execute-timeout",
+    "transfer.h2d": "watchdog.transfer-timeout",
+    "transfer.d2h": "watchdog.transfer-timeout",
+}
+
+
+def _tight_watchdog(cfg: Configuration, site: str,
+                    deadline: float = 0.015) -> Configuration:
+    """Tiny deadline for the site under trial so <=50ms injected hangs
+    trip the watchdog (tier-1 fast: a stall costs one deadline, not a
+    wall-clock hang)."""
+    cfg.set(_SITE_DEADLINE_KEY[site], deadline)
+    return cfg
+
+
+@pytest.mark.stall
+@pytest.mark.parametrize("site,device_batches,defer", [
+    ("device.compile", True, True),
+    ("device.execute", True, True),
+    # host batches + deferred fold: the ONE packed upload is the h2d site
+    ("transfer.h2d", False, True),
+    ("transfer.d2h", True, True),
+])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_single_hang_at_each_watchdog_site_is_absorbed(site, device_batches,
+                                                       defer, seed):
+    """One injected hang at each supervised device-path site: the
+    watchdog abandons the stalled attempt, the stall retries in place
+    (transient rung of the ladder), and results stay exactly-once —
+    deterministic across seeds (once@N schedules are seed-independent;
+    the seed exercises the replay contract)."""
+    if site != "device.compile":
+        # warm the program caches: a tight per-site deadline must see ONLY
+        # the injected hang, not a real first-dispatch XLA compile (the
+        # compile trial needs cold caches — its site IS the builder)
+        _run_device_trial("", seed=seed, device_batches=device_batches,
+                          defer=defer)
+        faults_mod.FAULTS.reset()
+        from flink_tpu.runtime.watchdog import WATCHDOG
+        WATCHDOG.reset()
+    else:
+        # cold caches regardless of test order: the builder IS the site
+        from flink_tpu.runtime.operators import device_window as dw
+        for builder in (dw._step_program, dw._fire_program,
+                        dw._native_fold_program):
+            builder.cache_clear()
+    wd0 = DEVICE_STATS.watchdog_trips
+    cfg = _tight_watchdog(_chaos_config(f"{site}=once@2!hang@40", seed),
+                          site)
+    got, op, h, (keys, vals, ts) = _run_device_trial(
+        "", seed=seed, config=cfg, device_batches=device_batches,
+        defer=defer)
+    assert got == _expected(keys, vals, ts)
+    assert not op._degraded, "a single stall must retry, not degrade"
+    assert DEVICE_STATS.watchdog_trips > wd0, "hang never tripped watchdog"
+    snap = faults_mod.FAULTS.snapshot()
+    assert snap["trips"].get(site) == 1
+
+
+@pytest.mark.stall
+def test_persistent_execute_hang_degrades_to_cpu_fallback():
+    """The acceptance trial: with !hang injected persistently at
+    device.execute, repeated stalls exhaust the guard's retries and the
+    operator degrades to the CPU fallback within the configured deadline
+    budget — producing byte-identical exactly-once results vs a clean
+    run, with watchdog_trips_total > 0 and a stall event on the REST
+    exceptions surface."""
+    from flink_tpu.cluster.rest import RestEndpoint
+    from flink_tpu.core.config import FaultOptions
+    from flink_tpu.runtime.watchdog import WATCHDOG
+    from types import SimpleNamespace
+
+    clean, op0, _h0, data = _run_device_trial("", seed=0)
+    assert not op0._degraded
+    faults_mod.FAULTS.reset()
+    WATCHDOG.reset()
+    d0 = DEVICE_STATS.degraded
+    wd0 = DEVICE_STATS.watchdog_trips
+    cfg = _tight_watchdog(_chaos_config("device.execute=always!hang@40", 0),
+                          "device.execute")
+    cfg.set(FaultOptions.DEVICE_MAX_RETRIES, 2)
+    t0 = time.perf_counter()
+    got, op, _h, _ = _run_device_trial("", seed=0, config=cfg)
+    wall = time.perf_counter() - t0
+    assert op._degraded, "persistent stalls never degraded the operator"
+    assert op._guard.stalls >= 3          # initial attempt + 2 retries
+    assert DEVICE_STATS.degraded == d0 + 1
+    assert DEVICE_STATS.watchdog_trips > wd0
+    assert got == clean
+    keys, vals, ts = data
+    assert got == _expected(keys, vals, ts)
+    # deadline budget: 3 attempts x 15ms deadlines + backoff, not the
+    # 40ms-per-visit hang schedule run to completion
+    assert wall < 30.0
+    # the stall events ride /jobs/<id>/exceptions
+    ep = RestEndpoint()
+    ep.register_job("chaos", SimpleNamespace(failure_history=[]))
+    kinds = [e["kind"] for e in ep._exceptions("chaos")["entries"]]
+    assert "watchdog-stall" in kinds
+
+
+@pytest.mark.stall
+@pytest.mark.parametrize("seed", [3, 5])
+def test_tiny_q5_pipeline_exactly_once_with_hang_injection(seed):
+    """Whole-pipeline stall chaos (what `bench.py --chaos` drives): a
+    bounded d2h hang schedule under a tight transfer deadline — every
+    stall is absorbed by the watchdog retry and the emitted stream stays
+    exactly-once, deterministically per seed."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.core.config import WatchdogOptions
+    from flink_tpu.core.watermarks import WatermarkStrategy
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    n, n_keys = 1 << 11, 23
+    spec = ("device.execute=once@3!hang@40,transfer.d2h=every@4!hang@40,"
+            "channel.send=once@2")
+
+    def gen(idx):
+        return {"k": (idx * 3) % n_keys, "v": (idx % 13) + 1,
+                "ts": (idx * 5 * PANE) // n}
+
+    schema = Schema([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+    env = StreamExecutionEnvironment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, 256)
+    env.config.set(StateOptions.TPU_HOST_INDEX, False)
+    env.config.set(FaultOptions.ENABLED, True)
+    env.config.set(FaultOptions.SEED, seed)
+    env.config.set(FaultOptions.SPEC, spec)
+    env.config.set(WatchdogOptions.EXECUTE_TIMEOUT, 0.015)
+    env.config.set(WatchdogOptions.TRANSFER_TIMEOUT, 0.015)
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _RowSink()
+    (env.datagen(gen, schema, count=n, timestamp_column="ts",
+                 watermark_strategy=ws)
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(PANE))
+        .device_aggregate([AggSpec("count", out_name="cnt", value_bits=31),
+                           AggSpec("sum", "v", out_name="total")],
+                          capacity=1 << 12, ring_size=8,
+                          emit_window_bounds=True, defer_overflow=True)
+        .add_sink(sink, "sink"))
+    env.execute(f"tiny-q5-stall-{seed}", timeout=60.0)
+
+    idx = np.arange(n)
+    expect = _expected((idx * 3) % n_keys, (idx % 13) + 1,
+                       (idx * 5 * PANE) // n)
+    got = {}
+    for k, _ws, we, cnt, total in sink.rows:
+        assert (int(k), int(we)) not in got, "duplicate window emission"
+        got[(int(k), int(we))] = (int(cnt), int(total))
+    assert got == expect, f"seed {seed}: results diverged under stalls"
+    assert DEVICE_STATS.watchdog_trips > 0
 
 
 # ---------------------------------------------------------------------------
