@@ -208,7 +208,8 @@ def _collect_metrics(env, before: dict) -> dict:
     # only under injection or a genuinely failing/hanging device path
     for k in ("device_retries_total", "device_degraded_total",
               "dead_letter_records_total", "injected_faults_total",
-              "watchdog_trips_total", "stall_detections_total"):
+              "watchdog_trips_total", "stall_detections_total",
+              "checkpoint_verify_failures_total", "restore_fallbacks_total"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
@@ -992,7 +993,12 @@ def chaos(seed: int) -> None:
     _emit_probe(probe)
     stages = run_tiny_q5(chaos_seed=seed)
     rec = {"metric": "nexmark_q5_tiny_chaos_report", "unit": "report",
-           "chaos_spec": CHAOS_SPEC}
+           "chaos_spec": CHAOS_SPEC,
+           # verified-recovery surface: restore fallbacks taken and
+           # artifact verification failures seen during the chaos run
+           "restore_fallbacks": stages.get("restore_fallbacks_total", 0),
+           "verify_failures": stages.get(
+               "checkpoint_verify_failures_total", 0)}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in stages.items()})
     print(json.dumps(rec))

@@ -1,0 +1,10 @@
+"""Checkpointing: coordinator, storage, and verified-recovery errors."""
+
+from .storage import (
+    CheckpointNotFoundError, CheckpointStorage, CompletedCheckpoint,
+    CorruptArtifactError, FsCheckpointStorage, MemoryCheckpointStorage,
+)
+
+__all__ = ["CheckpointNotFoundError", "CheckpointStorage",
+           "CompletedCheckpoint", "CorruptArtifactError",
+           "FsCheckpointStorage", "MemoryCheckpointStorage"]

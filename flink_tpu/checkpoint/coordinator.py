@@ -27,8 +27,8 @@ from typing import Any, Optional
 from ..core.config import CheckpointingOptions, Configuration
 from ..core.elements import CheckpointBarrier
 from .storage import (
-    CheckpointStorage, CompletedCheckpoint, FsCheckpointStorage,
-    MemoryCheckpointStorage,
+    CheckpointNotFoundError, CheckpointStorage, CompletedCheckpoint,
+    CorruptArtifactError, FsCheckpointStorage, MemoryCheckpointStorage,
 )
 
 __all__ = ["CheckpointCoordinator", "build_restore_map"]
@@ -101,8 +101,12 @@ class CheckpointCoordinator:
         self.config = config
         self.tracer = tracer
         directory = config.get(CheckpointingOptions.DIRECTORY)
-        self.storage = storage or (FsCheckpointStorage(directory) if directory
-                                   else MemoryCheckpointStorage())
+        self.storage = storage or (
+            FsCheckpointStorage(directory, config=config) if directory
+            else MemoryCheckpointStorage())
+        # restore-candidate verification events (kind 'corrupt-artifact'),
+        # merged into the job failure history -> REST /jobs/<n>/exceptions
+        self.verify_failures: list[dict] = []
         self.retained = config.get(CheckpointingOptions.RETAINED)
         self.timeout = config.get(CheckpointingOptions.TIMEOUT)
         self.min_pause = config.get(CheckpointingOptions.MIN_PAUSE)
@@ -292,6 +296,69 @@ class CheckpointCoordinator:
     def latest_checkpoint(self) -> Optional[CompletedCheckpoint]:
         with self._lock:
             return self._completed[-1] if self._completed else None
+
+    def latest_verified_checkpoint(self) -> Optional[CompletedCheckpoint]:
+        """The newest retained checkpoint whose ON-DISK artifact passes
+        integrity verification — what every restore decision must use.
+
+        Walks backward through the retained list: a candidate that fails
+        verification is counted (``checkpoint_verify_failures_total``),
+        recorded on the job failure history (kind ``corrupt-artifact`` →
+        REST ``/jobs/<name>/exceptions``), quarantined on disk
+        (``<dir>.corrupt``, refs dropped), and removed from the retained
+        list; the walk continues to the next-oldest. Raises
+        CorruptArtifactError when retained checkpoints exist but NONE
+        verifies — restarting from scratch would replay the whole stream
+        past committed output, so that must be a terminal job failure,
+        never a silent restore of garbage (or nothing)."""
+        from ..metrics.device import DEVICE_STATS
+
+        verify = self.config.get(CheckpointingOptions.VERIFY_ON_RESTORE)
+        quarantine = self.config.get(CheckpointingOptions.QUARANTINE_CORRUPT)
+        skipped = 0
+        while True:
+            with self._lock:
+                cand = self._completed[-1] if self._completed else None
+            if cand is None:
+                if skipped:
+                    raise CorruptArtifactError(
+                        f"all {skipped} retained checkpoints failed "
+                        "verification; refusing to restore garbage state")
+                return None
+            if (not verify
+                    or not isinstance(self.storage, FsCheckpointStorage)
+                    or not cand.external_path):
+                break  # nothing on disk to verify (in-memory storage)
+            try:
+                self.storage.verify_checkpoint(cand.external_path)
+            except (CorruptArtifactError, CheckpointNotFoundError) as e:
+                skipped += 1
+                DEVICE_STATS.note_verify_failure("checkpoint.restore")
+                event = {"timestamp": time.time(),
+                         "kind": "corrupt-artifact",
+                         "checkpoint": cand.checkpoint_id,
+                         "path": cand.external_path,
+                         "error": f"{type(e).__name__}: {e}"}
+                self.verify_failures.append(event)
+                hist = getattr(self.job, "failure_history", None)
+                if hist is not None:
+                    hist.append(event)
+                with self._lock:
+                    if cand in self._completed:
+                        self._completed.remove(cand)
+                if quarantine:
+                    self.storage.quarantine(cand)
+                continue
+            break
+        if skipped:
+            DEVICE_STATS.note_restore_fallback("checkpoint.restore")
+            hist = getattr(self.job, "failure_history", None)
+            if hist is not None:
+                hist.append({"timestamp": time.time(),
+                             "kind": "restore-fallback",
+                             "checkpoint": cand.checkpoint_id,
+                             "skipped": skipped})
+        return cand
 
     # -- periodic loop -----------------------------------------------------
     def start_periodic(self) -> None:

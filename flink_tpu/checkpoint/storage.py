@@ -18,11 +18,21 @@ logically self-contained (its manifest references the chunks it needs; a
 refcount GC deletes chunks when their last referencing checkpoint is
 subsumed). Savepoints are always written full and inline (user-owned,
 relocatable — reference canonical-format semantics).
+
+Verified recovery: every stored checkpoint carries a ``_manifest.json``
+(per-chunk payload sizes + digests and a whole-metadata checksum,
+committed write-tmp/fsync/rename), restore recomputes chunk content
+digests against the manifest/filename and raises a typed
+``CorruptArtifactError`` instead of materializing garbage, and the
+restore paths walk backward through the retained checkpoints when a
+candidate fails verification (quarantining the corrupt artifact as
+``<dir>.corrupt``). See docs/ROBUSTNESS.md "Verified recovery".
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 import shutil
@@ -32,8 +42,72 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ..core.config import CheckpointingOptions
+
 __all__ = ["CompletedCheckpoint", "CheckpointStorage", "MemoryCheckpointStorage",
-           "FsCheckpointStorage"]
+           "FsCheckpointStorage", "CorruptArtifactError",
+           "CheckpointNotFoundError", "retained_checkpoint_dirs"]
+
+
+class CorruptArtifactError(RuntimeError):
+    """A checkpoint artifact (chunk, metadata, changelog segment) failed
+    its integrity check — digest mismatch, truncation, or an undecodable
+    payload. Restore paths treat the artifact as unusable and fall back
+    to the next-oldest retained checkpoint; the job fails with this
+    error only when NO retained checkpoint verifies (restoring from
+    scratch past committed output would violate exactly-once)."""
+
+
+class CheckpointNotFoundError(FileNotFoundError, KeyError):
+    """No checkpoint exists at the requested id/path. Subclasses both
+    FileNotFoundError and KeyError so pre-typed callers keep working."""
+
+    def __str__(self):  # KeyError quotes its arg; keep the message plain
+        return self.args[0] if self.args else ""
+
+
+#: Per-checkpoint integrity manifest (sibling of ``_metadata``).
+MANIFEST_NAME = "_manifest.json"
+
+
+def _payload_digest(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """Atomic durable publish: write-tmp, fsync, rename."""
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def retained_checkpoint_dirs(directory: str) -> list:
+    """``(checkpoint_id, path)`` for every retained ``chk-*``/``sp-*``
+    directory under ``directory``, ordered oldest first. Quarantined
+    ``*.corrupt`` directories and non-checkpoint entries are skipped."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        if ".corrupt" in name:
+            continue
+        prefix, _, tail = name.partition("-")
+        if prefix not in ("chk", "sp") or not tail:
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            out.append((int(tail), path))
+        except ValueError:
+            continue
+    out.sort()
+    return out
 
 
 @dataclass
@@ -105,7 +179,12 @@ class MemoryCheckpointStorage(CheckpointStorage):
         self._store.pop(checkpoint.checkpoint_id, None)
 
     def load(self, checkpoint_id: int) -> CompletedCheckpoint:
-        return self._store[checkpoint_id]
+        try:
+            return self._store[checkpoint_id]
+        except KeyError:
+            raise CheckpointNotFoundError(
+                f"no checkpoint with id {checkpoint_id} in memory "
+                "storage") from None
 
 
 class _ChunkRef:
@@ -146,21 +225,86 @@ N_PAGES = 16  # key-group space divided into this many dedup pages
 
 
 class FsCheckpointStorage(CheckpointStorage):
-    def __init__(self, directory: str, incremental: bool = True):
+    def __init__(self, directory: str, incremental: bool = True,
+                 config=None):
         self.directory = directory
         self.incremental = incremental
         self.chunk_dir = os.path.join(directory, "chunks")
         os.makedirs(self.chunk_dir, exist_ok=True)
         self._refs_path = os.path.join(self.chunk_dir, "_refs.pkl")
+        # payload identity (size, digest of the stored bytes) per chunk,
+        # captured at write time so manifests never re-read every chunk;
+        # pre-existing chunks are read once on first reference
+        self._chunk_info: dict[str, tuple] = {}
+        self._current_chunks: set = set()  # chunks referenced by one store
+        self.verify_on_restore = True
+        self.quarantine_corrupt = True
+        if config is not None:
+            self.verify_on_restore = bool(
+                config.get(CheckpointingOptions.VERIFY_ON_RESTORE))
+            self.quarantine_corrupt = bool(
+                config.get(CheckpointingOptions.QUARANTINE_CORRUPT))
+        # refs load LAST: a lost/corrupt refs file rebuilds by scanning
+        # checkpoint manifests/metadata, which needs the flags above
         self._refs: dict[str, set] = self._load_refs()
         self.last_bytes_written = 0  # chunk + metadata bytes of last store
 
     def _load_refs(self) -> dict[str, set]:
+        """Refcounts from ``_refs.pkl`` — rebuilt by scanning the
+        surviving checkpoint manifests when the file is lost OR corrupt.
+        Starting from ``{}`` after a lost refs file would let GC delete
+        chunks still referenced by retained checkpoints; a corrupt pickle
+        (not just a short read) used to crash storage construction."""
         try:
             with open(self._refs_path, "rb") as f:
-                return pickle.load(f)
-        except (OSError, EOFError):
-            return {}
+                refs = pickle.load(f)
+            if isinstance(refs, dict):
+                return refs
+        except FileNotFoundError:
+            # a fresh directory has no refs file AND no checkpoints: the
+            # rebuild below naturally returns {} then — and recovers the
+            # real counts when checkpoints exist but the file was lost
+            pass
+        except Exception:  # noqa: BLE001 - any unpicklable/corrupt refs
+            pass
+        return self._rebuild_refs()
+
+    def _rebuild_refs(self) -> dict:
+        """Scan every retained checkpoint for the chunks it references:
+        the manifest's chunk list when present, else the decoded metadata
+        (legacy checkpoints). Unreadable checkpoints contribute nothing —
+        their chunks are only GC-able once every READABLE referent is
+        subsumed, which errs on the side of keeping bytes."""
+        refs: dict = {}
+
+        def note(h, cid):
+            refs.setdefault(h, set()).add(cid)
+
+        def walk(obj, cid):
+            if isinstance(obj, _PagedState):
+                for p in obj.pages:
+                    note(p.hash if isinstance(p, _ChunkRef) else p, cid)
+            elif isinstance(obj, _ChunkRef):
+                note(obj.hash, cid)
+            elif isinstance(obj, dict):
+                for v in obj.values():
+                    walk(v, cid)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    walk(v, cid)
+
+        for cid, path in retained_checkpoint_dirs(self.directory):
+            try:
+                manifest = self._read_manifest(path)
+                if manifest is not None:
+                    for name in (manifest.get("chunks") or {}):
+                        note(bytes.fromhex(name), cid)
+                    continue
+                cp = self._load_inner(path, resolve=False)
+                walk(cp.task_snapshots, cid)
+            except Exception:  # noqa: BLE001 - skip unreadable checkpoints
+                continue
+        return refs
 
     def _save_refs(self) -> None:
         with open(self._refs_path + ".part", "wb") as f:
@@ -181,7 +325,8 @@ class FsCheckpointStorage(CheckpointStorage):
         h = hashlib.blake2b(
             raw + str((arr.dtype, arr.shape[:-1])).encode(),
             digest_size=16).digest()
-        path = os.path.join(self.chunk_dir, h.hex())
+        name = h.hex()
+        path = os.path.join(self.chunk_dir, name)
         if not os.path.exists(path):
             from ..native import compress
             payload = compress(raw)
@@ -189,8 +334,48 @@ class FsCheckpointStorage(CheckpointStorage):
                 f.write(payload)
             os.replace(path + ".part", path)
             self.last_bytes_written += len(payload)
+            self._chunk_info[name] = (len(payload), _payload_digest(payload))
+        elif name not in self._chunk_info:
+            # dedup hit on a chunk written by a previous process: capture
+            # its payload identity once so manifests never re-read every
+            # chunk per checkpoint
+            with open(path, "rb") as f:
+                data = f.read()
+            self._chunk_info[name] = (len(data), _payload_digest(data))
         self._refs.setdefault(h, set()).add(ckpt_id)
+        self._current_chunks.add(name)
+        # artifact-corruption fault sites fire AFTER the manifest identity
+        # was captured, so verification sees exactly what a bad disk would
+        # produce (and a shared-chunk hit poisons every referent, the
+        # scenario the fallback chain exists for)
+        self._fault_mutate_chunk(path)
         return h
+
+    @staticmethod
+    def _fault_mutate_chunk(path: str) -> None:
+        """Deterministic artifact-corruption sites: every chunk write
+        visits ``checkpoint.corrupt`` (bit-flip one byte mid-file) and
+        ``checkpoint.truncate`` (drop the second half of the file)."""
+        from ..runtime.faults import FAULTS
+        if not FAULTS.enabled:
+            return
+        if FAULTS.check("checkpoint.corrupt"):
+            try:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.seek(size // 2)
+                    b = f.read(1)
+                    f.seek(size // 2)
+                    f.write(bytes([(b[0] if b else 0) ^ 0x40]))
+            except OSError:
+                pass
+        if FAULTS.check("checkpoint.truncate"):
+            try:
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(size // 2, 1))
+            except OSError:
+                pass
 
     def _read_chunk(self, ref, chunk_dir: Optional[str] = None,
                     dtype: Optional[str] = None,
@@ -200,17 +385,47 @@ class FsCheckpointStorage(CheckpointStorage):
         else:
             name, dt = ref.hex(), np.dtype(dtype)
             shape = None
-        with open(os.path.join(chunk_dir or self.chunk_dir, name),
-                  "rb") as f:
+        path = os.path.join(chunk_dir or self.chunk_dir, name)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except FileNotFoundError as e:
+            raise CorruptArtifactError(
+                f"checkpoint chunk {name} is missing from "
+                f"{os.path.dirname(path)}") from e
+        try:
             from ..native import decompress
-            raw = decompress(f.read())
+            raw = decompress(payload)
+        except CorruptArtifactError:
+            raise
+        except Exception as e:  # noqa: BLE001 - truncated/garbled payload
+            raise CorruptArtifactError(
+                f"checkpoint chunk {name} is undecodable "
+                f"({type(e).__name__}: {e})") from e
         if shape is None:
+            if self.verify_on_restore:
+                # the filename IS the content digest: recompute it from
+                # the decompressed bytes + the dtype/lead-shape that
+                # participated in the write-side hash
+                got = hashlib.blake2b(
+                    raw + str((dt, tuple(lead_shape or ()))).encode(),
+                    digest_size=16).digest()
+                if got != ref:
+                    raise CorruptArtifactError(
+                        f"checkpoint chunk {name} failed content-digest "
+                        "verification (stored bytes do not hash to the "
+                        "chunk's content address)")
             lead = 1
             for d in lead_shape:
                 lead *= d
             n = len(raw) // dt.itemsize
             shape = tuple(lead_shape) + (n // lead if lead else 0,)
-        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+        try:
+            return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+        except ValueError as e:
+            raise CorruptArtifactError(
+                f"checkpoint chunk {name} has the wrong byte count for "
+                f"shape {shape} ({e})") from e
 
     def _page_tpu_snapshot(self, snap: dict, ckpt_id: int) -> dict:
         """Reorder a device keyed snapshot by key group and replace its
@@ -376,6 +591,7 @@ class FsCheckpointStorage(CheckpointStorage):
         # knows where it lives
         checkpoint.external_path = d
         self.last_bytes_written = 0
+        self._current_chunks = set()
         to_write = checkpoint
         incremental = self.incremental and not checkpoint.is_savepoint
         if incremental:
@@ -388,20 +604,32 @@ class FsCheckpointStorage(CheckpointStorage):
         # (io/compression/BlockCompressionFactory); native LZ4-style codec
         # when built, zlib otherwise — self-describing tag either way
         from ..native import compress
-        payload = compress(pickle.dumps(
+        meta_bytes = _VERSIONED_MAGIC + compress(pickle.dumps(
             self._encode(to_write), protocol=pickle.HIGHEST_PROTOCOL))
-        tmp = os.path.join(d, "_metadata.part")
-        with open(tmp, "wb") as f:
-            f.write(_VERSIONED_MAGIC)
-            f.write(payload)
-        final = os.path.join(d, "_metadata")
-        os.replace(tmp, final)  # atomic publish
+        # integrity manifest first, metadata rename last: the metadata
+        # stays the commit point, and a published checkpoint always has
+        # its manifest. A crash between chunk writes and these renames
+        # leaves orphan chunks + an incomplete dir — never a checkpoint
+        # that loads without being verifiable.
+        manifest = {
+            "format": 1,
+            "checkpoint_id": checkpoint.checkpoint_id,
+            "savepoint": bool(checkpoint.is_savepoint),
+            "metadata_size": len(meta_bytes),
+            "metadata_digest": _payload_digest(meta_bytes),
+            "chunks": {name: {"size": self._chunk_info[name][0],
+                              "digest": self._chunk_info[name][1]}
+                       for name in sorted(self._current_chunks)},
+        }
+        _fsync_write(os.path.join(d, MANIFEST_NAME),
+                     json.dumps(manifest, sort_keys=True).encode())
+        _fsync_write(os.path.join(d, "_metadata"), meta_bytes)
         if incremental:
             # refs persist only AFTER the metadata exists: a crash mid-store
             # leaves orphan chunk files (re-usable, GC-able) rather than
             # phantom refs that would pin shared chunks forever
             self._save_refs()
-        self.last_bytes_written += len(payload)
+        self.last_bytes_written += len(meta_bytes)
         return checkpoint
 
     def discard(self, checkpoint: CompletedCheckpoint) -> None:
@@ -409,8 +637,11 @@ class FsCheckpointStorage(CheckpointStorage):
             return  # savepoints are user-owned (reference semantics)
         d = self._path(checkpoint)
         shutil.rmtree(d, ignore_errors=True)
-        # release this checkpoint's chunk references; GC orphans
-        cid = checkpoint.checkpoint_id
+        self._release_refs(checkpoint.checkpoint_id)
+
+    def _release_refs(self, cid: int) -> None:
+        """Drop one checkpoint's chunk references; GC chunks whose last
+        referent it was (shared chunks survive for older checkpoints)."""
         dead = []
         for h, refs in self._refs.items():
             refs.discard(cid)
@@ -425,6 +656,105 @@ class FsCheckpointStorage(CheckpointStorage):
                 pass
         if dead:
             self._save_refs()
+
+    # -- verification ---------------------------------------------------
+    @staticmethod
+    def _read_manifest(path: str) -> Optional[dict]:
+        """The checkpoint directory's integrity manifest, or None for a
+        legacy (pre-manifest) checkpoint. An unreadable manifest IS
+        corruption — it was fsync-renamed atomically."""
+        try:
+            with open(os.path.join(path, MANIFEST_NAME), "rb") as f:
+                return json.loads(f.read())
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            raise CorruptArtifactError(
+                f"unreadable checkpoint manifest in {path}: {e}") from e
+
+    def verify_checkpoint(self, path: str) -> dict:
+        """Offline integrity check of one stored checkpoint: the
+        manifest's whole-metadata checksum plus every referenced chunk's
+        size and payload digest (no decompression, no materialization).
+        Legacy checkpoints without a manifest are verified the expensive
+        way — a full decode+resolve, which checks the content digests of
+        every new-style chunk ref. Returns ``{"chunks": n, "bytes": m,
+        "manifest": bool}``; raises CheckpointNotFoundError /
+        CorruptArtifactError."""
+        d = path.rstrip("/")
+        meta = d if d.endswith("_metadata") else os.path.join(d, "_metadata")
+        d = os.path.dirname(meta)
+        try:
+            with open(meta, "rb") as f:
+                meta_bytes = f.read()
+        except FileNotFoundError as e:
+            raise CheckpointNotFoundError(
+                f"no checkpoint metadata at {meta}") from e
+        manifest = self._read_manifest(d)
+        if manifest is None:
+            try:
+                self._load_inner(meta, resolve=True)
+            except (CorruptArtifactError, CheckpointNotFoundError):
+                raise
+            except Exception as e:  # noqa: BLE001 - undecodable legacy
+                raise CorruptArtifactError(
+                    f"legacy checkpoint at {d} is undecodable "
+                    f"({type(e).__name__}: {e})") from e
+            return {"chunks": 0, "bytes": len(meta_bytes), "manifest": False}
+        if (manifest.get("metadata_size") != len(meta_bytes)
+                or manifest.get("metadata_digest")
+                != _payload_digest(meta_bytes)):
+            raise CorruptArtifactError(
+                f"checkpoint metadata at {meta} does not match its "
+                "manifest checksum")
+        chunk_dir = os.path.join(os.path.dirname(os.path.abspath(d)),
+                                 "chunks")
+        total = len(meta_bytes)
+        for name, info in (manifest.get("chunks") or {}).items():
+            cpath = os.path.join(chunk_dir, name)
+            try:
+                with open(cpath, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError as e:
+                raise CorruptArtifactError(
+                    f"chunk {name} referenced by {d} is missing") from e
+            if (len(data) != info.get("size")
+                    or _payload_digest(data) != info.get("digest")):
+                raise CorruptArtifactError(
+                    f"chunk {name} referenced by {d} failed its "
+                    "size/digest check")
+            total += len(data)
+        return {"chunks": len(manifest.get("chunks") or {}),
+                "bytes": total, "manifest": True}
+
+    def quarantine(self, checkpoint_or_path) -> Optional[str]:
+        """Quarantine a corrupt checkpoint: rename its directory to
+        ``<dir>.corrupt`` (so it never sits first in the restore order
+        again) and release its chunk refs — chunks whose only referent it
+        was are GC'd; shared chunks survive for the older retained
+        checkpoints that still reference them. Returns the quarantine
+        path, or None when the rename was impossible."""
+        if isinstance(checkpoint_or_path, CompletedCheckpoint):
+            d = (checkpoint_or_path.external_path
+                 or self._path(checkpoint_or_path))
+            cid = checkpoint_or_path.checkpoint_id
+        else:
+            d = str(checkpoint_or_path).rstrip("/")
+            try:
+                cid = int(os.path.basename(d).split("-", 1)[1])
+            except (IndexError, ValueError):
+                cid = None
+        dest, i = d + ".corrupt", 0
+        while os.path.exists(dest):
+            i += 1
+            dest = f"{d}.corrupt.{i}"
+        try:
+            os.rename(d, dest)
+        except OSError:
+            dest = None
+        if cid is not None:
+            self._release_refs(cid)
+        return dest
 
     def load(self, path: str,
              resolve: bool = True) -> CompletedCheckpoint:
@@ -444,18 +774,41 @@ class FsCheckpointStorage(CheckpointStorage):
     def _load_inner(self, path: str, resolve: bool) -> CompletedCheckpoint:
         meta = path if path.endswith("_metadata") else os.path.join(path,
                                                                     "_metadata")
-        with open(meta, "rb") as f:
-            data = f.read()
-        if data.startswith(_VERSIONED_MAGIC):
-            from ..native import decompress
-            cp = self._decode(pickle.loads(
-                decompress(data[len(_VERSIONED_MAGIC):])))
-        elif data.startswith(_COMPRESSED_MAGIC):
-            # format v1: compressed class-pickle
-            from ..native import decompress
-            cp = pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
-        else:
-            cp = pickle.loads(data)  # pre-compression snapshots
+        try:
+            with open(meta, "rb") as f:
+                data = f.read()
+        except FileNotFoundError as e:
+            raise CheckpointNotFoundError(
+                f"no checkpoint at {path}") from e
+        if self.verify_on_restore:
+            # whole-metadata checksum from the manifest (when one exists:
+            # legacy checkpoints predate manifests) BEFORE decoding
+            manifest = self._read_manifest(
+                os.path.dirname(os.path.abspath(meta)))
+            if manifest is not None and (
+                    manifest.get("metadata_size") != len(data)
+                    or manifest.get("metadata_digest")
+                    != _payload_digest(data)):
+                raise CorruptArtifactError(
+                    f"checkpoint metadata at {meta} does not match its "
+                    "manifest checksum")
+        try:
+            if data.startswith(_VERSIONED_MAGIC):
+                from ..native import decompress
+                cp = self._decode(pickle.loads(
+                    decompress(data[len(_VERSIONED_MAGIC):])))
+            elif data.startswith(_COMPRESSED_MAGIC):
+                # format v1: compressed class-pickle
+                from ..native import decompress
+                cp = pickle.loads(decompress(data[len(_COMPRESSED_MAGIC):]))
+            else:
+                cp = pickle.loads(data)  # pre-compression snapshots
+        except CorruptArtifactError:
+            raise
+        except Exception as e:  # noqa: BLE001 - truncated/garbled metadata
+            raise CorruptArtifactError(
+                f"checkpoint metadata at {meta} is undecodable "
+                f"({type(e).__name__}: {e})") from e
         # chunk refs resolve against the sibling chunks/ dir of wherever
         # this metadata actually lives (the storage instance may have been
         # constructed for a different root)

@@ -37,8 +37,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from ..checkpoint.storage import CompletedCheckpoint, FsCheckpointStorage, \
-    MemoryCheckpointStorage
+from ..checkpoint.storage import (
+    CheckpointNotFoundError, CompletedCheckpoint, CorruptArtifactError,
+    FsCheckpointStorage, MemoryCheckpointStorage,
+)
 from ..core.config import (
     CheckpointingOptions, Configuration, RuntimeOptions, StateOptions,
 )
@@ -58,6 +60,10 @@ from .transport import RemoteChannelSender, TransportServer
 __all__ = ["DistributedHost", "run_distributed", "subtask_host"]
 
 _MSG = struct.Struct("<I")
+
+#: Sentinel: checkpoints existed but none passed verification — the
+#: restart must fail the job, never silently redeploy from scratch.
+_NO_VERIFIED_CHECKPOINT = object()
 
 
 def subtask_host(subtask: int, n_hosts: int) -> int:
@@ -116,8 +122,8 @@ class _Coordinator:
         self.n_hosts = n_hosts
         self.config = config
         directory = config.get(CheckpointingOptions.DIRECTORY)
-        self.storage = (FsCheckpointStorage(directory) if directory
-                        else MemoryCheckpointStorage())
+        self.storage = (FsCheckpointStorage(directory, config=config)
+                        if directory else MemoryCheckpointStorage())
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind(("127.0.0.1", port))
@@ -338,6 +344,52 @@ class _Coordinator:
                             "savepoint": complete.is_savepoint})
 
     # -- failover ----------------------------------------------------------
+    def _verified_candidate_locked(self):
+        """Newest completed checkpoint whose on-disk artifact verifies
+        (caller holds ``self._lock``). Corrupt candidates are counted,
+        recorded in the failure history (kind ``corrupt-artifact``),
+        quarantined (``<dir>.corrupt``), and dropped from the retained
+        list — the walk falls back to the next-oldest. Returns None when
+        no checkpoint ever completed (restart from scratch is legitimate
+        then), or the ``_NO_VERIFIED_CHECKPOINT`` sentinel when
+        checkpoints existed but every one failed verification."""
+        from ..metrics.device import DEVICE_STATS
+
+        verify = self.config.get(CheckpointingOptions.VERIFY_ON_RESTORE)
+        quarantine = self.config.get(
+            CheckpointingOptions.QUARANTINE_CORRUPT)
+        dropped = 0
+        while self.completed:
+            cand = self.completed[-1]
+            if (not verify
+                    or not isinstance(self.storage, FsCheckpointStorage)
+                    or not cand.external_path):
+                break
+            try:
+                self.storage.verify_checkpoint(cand.external_path)
+            except (CorruptArtifactError, CheckpointNotFoundError) as e:
+                dropped += 1
+                self.completed.pop()
+                DEVICE_STATS.note_verify_failure("checkpoint.restore")
+                self.failure_history.append({
+                    "timestamp": time.time(), "kind": "corrupt-artifact",
+                    "checkpoint": cand.checkpoint_id,
+                    "path": cand.external_path,
+                    "error": f"{type(e).__name__}: {e}"})
+                if quarantine:
+                    self.storage.quarantine(cand)
+                continue
+            break
+        if not self.completed and dropped:
+            return _NO_VERIFIED_CHECKPOINT
+        cp = self.completed[-1] if self.completed else None
+        if dropped and cp is not None:
+            DEVICE_STATS.note_restore_fallback("checkpoint.restore")
+            self.failure_history.append({
+                "timestamp": time.time(), "kind": "restore-fallback",
+                "checkpoint": cp.checkpoint_id, "skipped": dropped})
+        return cp
+
     def _maybe_restart(self, dead: list[int], reason: str) -> bool:
         """Redeploy the job over the surviving workers from the latest
         completed checkpoint (reference region failover collapsed to
@@ -397,8 +449,16 @@ class _Coordinator:
             self._pending_hosts.clear()
             for w in self._workers.values():
                 w.finished = False
-            cp = self.completed[-1] if self.completed else None
+            cp = self._verified_candidate_locked()
             self._restart_inflight = False
+        if cp is _NO_VERIFIED_CHECKPOINT:
+            # checkpoints existed but none verifies: redeploying from
+            # scratch would replay the whole stream past committed output
+            # — fail the job with the typed corruption error instead
+            self.failed = (f"{reason}; CorruptArtifactError: all retained "
+                           "checkpoints failed verification")
+            self.broadcast({"type": "cancel"})
+            return
         msg = {"type": "restart", "epoch": epoch, "live_hosts": live,
                "slots": self.resources.slots_map(live),
                "reason": reason, "checkpoint_path": None, "checkpoint": None}
@@ -912,7 +972,8 @@ class DistributedHost:
         path = intent.get("checkpoint_path")
         storage = None
         if cp is None and path:
-            storage = FsCheckpointStorage(str(path).rsplit("/", 1)[0])
+            storage = FsCheckpointStorage(str(path).rsplit("/", 1)[0],
+                                          config=self.config)
             # metadata only; chunk reads happen per task AFTER local
             # substitution so locally-covered tasks never touch storage
             cp = storage.load(path, resolve=False)
@@ -1015,7 +1076,30 @@ class DistributedHost:
                     if self.host_id not in live:
                         break
                     slots = intent.get("slots") or slots
-                    restored = self._load_restore_map(intent)
+                    try:
+                        restored = self._load_restore_map(intent)
+                    except CorruptArtifactError as e:
+                        # the artifact went bad between the coordinator's
+                        # verification and this read (or corruption raced
+                        # the restart): NEVER deploy with partial/garbage
+                        # state — report the failure so the coordinator
+                        # re-runs its verified-candidate walk and orders a
+                        # restart from an older checkpoint
+                        if self._ctrl is None:
+                            raise
+                        try:
+                            self._ctrl_send({
+                                "type": "failed", "host_id": self.host_id,
+                                "epoch": epoch,
+                                "error": f"corrupt restore artifact: {e}"})
+                        except (OSError, StallError):
+                            raise e
+                        wait_s = self._max_restart_wait()
+                        if remaining() is not None:
+                            wait_s = min(wait_s, remaining())
+                        if not self._restart_event.wait(wait_s):
+                            raise
+                        continue
                 job = self.deploy(peer_data_addrs, live_hosts=live,
                                   epoch=epoch, restored=restored, slots=slots)
                 job.checkpoint_listener = self._make_listener()

@@ -346,6 +346,11 @@ class FileHaServices:
                 return pickle.loads(f.read())
         except OSError:
             return None
+        except Exception:  # noqa: BLE001 - corrupt/truncated record
+            # an unreadable HA record is treated like a missing one: the
+            # recovery path falls back to scanning the retained checkpoint
+            # directories on disk (HaJobSupervisor._verified_restore)
+            return None
 
 
 class HaJobSupervisor:
@@ -385,6 +390,59 @@ class HaJobSupervisor:
         if sup is not None and sup.current_job is not None:
             sup.current_job.cancel()
 
+    def _verified_restore(self, restore):
+        """Verify the HA checkpoint pointer's on-disk artifact before a
+        fresh leader resumes from it; on corruption — or when the HA
+        record itself was unreadable (``restore is None`` with retained
+        checkpoints on disk) — quarantine and walk backward through the
+        retained checkpoint directories, newest first, restoring the
+        first that verifies. Raises CorruptArtifactError when retained
+        checkpoints exist but none verifies (a leader must never resume a
+        job on garbage — or silently-reset — state)."""
+        from ..checkpoint.storage import (
+            CheckpointNotFoundError, CorruptArtifactError,
+            FsCheckpointStorage, retained_checkpoint_dirs,
+        )
+        from ..core.config import CheckpointingOptions
+        from ..metrics.device import DEVICE_STATS
+
+        if not self.config.get(CheckpointingOptions.VERIFY_ON_RESTORE):
+            return restore
+        pointer_path = (getattr(restore, "external_path", None)
+                        if restore is not None else None)
+        root = (os.path.dirname(pointer_path.rstrip("/")) if pointer_path
+                else self.config.get(CheckpointingOptions.DIRECTORY))
+        if not root or not os.path.isdir(root):
+            return restore  # in-memory checkpoints: nothing on disk
+        storage = FsCheckpointStorage(root, config=self.config)
+        quarantine = self.config.get(CheckpointingOptions.QUARANTINE_CORRUPT)
+        candidates = sorted(retained_checkpoint_dirs(root), reverse=True)
+        if not candidates and pointer_path:
+            candidates = [(restore.checkpoint_id, pointer_path)]
+        skipped = 0
+        for cid, path in candidates:
+            try:
+                storage.verify_checkpoint(path)
+                if pointer_path and os.path.abspath(path) == \
+                        os.path.abspath(pointer_path):
+                    cp = restore  # pointer record already holds the state
+                else:
+                    cp = storage.load(path)
+                if skipped:
+                    DEVICE_STATS.note_restore_fallback("ha.restore")
+                return cp
+            except (CorruptArtifactError, CheckpointNotFoundError):
+                skipped += 1
+                DEVICE_STATS.note_verify_failure("ha.restore")
+                if quarantine:
+                    storage.quarantine(path)
+                continue
+        if skipped:
+            raise CorruptArtifactError(
+                f"HA recovery of job {self.job_id}: all {skipped} retained "
+                "checkpoints failed verification")
+        return restore
+
     def run(self, timeout: float = 60.0) -> dict:
         """Contend; when leading, recover + supervise to completion.
         Returns the job result dict ({"status": "done", ...})."""
@@ -409,7 +467,8 @@ class HaJobSupervisor:
                 jg = self.ha.get_job_graph(self.job_id)
                 if jg is None:
                     raise RuntimeError(f"job {self.job_id} not in HA store")
-                restore = self.ha.get_checkpoint(self.job_id)
+                restore = self._verified_restore(
+                    self.ha.get_checkpoint(self.job_id))
                 self.supervisor = JobSupervisor(jg, self.config)
                 orig_deploy = self.supervisor._deploy
 

@@ -141,10 +141,13 @@ class JobSupervisor:
                 self._stop_supervision()
                 raise
             except RuntimeError as e:
-                # task failure: snapshot the latest checkpoint, consult the
-                # restart strategy, redeploy (reference maybeRestartTasks)
+                # task failure: snapshot the latest VERIFIED checkpoint,
+                # consult the restart strategy, redeploy (reference
+                # maybeRestartTasks). Corrupt artifacts are quarantined and
+                # skipped; CorruptArtifactError propagates (job failure)
+                # only when NO retained checkpoint verifies.
                 self._stop_supervision()
-                latest = self.coordinator.latest_checkpoint()
+                latest = self.coordinator.latest_verified_checkpoint()
                 if latest is not None:
                     self._latest = latest
                 self.failures.append((self.attempt, str(e)))
@@ -191,7 +194,7 @@ class JobSupervisor:
             "timestamp": time.time(), "attempt": self.attempt,
             "kind": "region-restart", "error": str(failed[0][1]),
             "vertices": sorted(vids)})
-        latest = self.coordinator.latest_checkpoint()
+        latest = self.coordinator.latest_verified_checkpoint()
         restored = {}
         if latest is not None:
             self._latest = latest

@@ -53,6 +53,10 @@ class DeviceStats:
         # task-progress / backpressure stall detections per scope
         self._watchdog_trips: dict[str, int] = {}
         self._stalls: dict[str, int] = {}
+        # verified-recovery accounting (PR 4): restore-candidate artifact
+        # verification failures and restore fallbacks per scope
+        self._verify_failures: dict[str, int] = {}
+        self._restore_fallbacks: dict[str, int] = {}
         self._tracer = None  # optional Tracer receiving Compile spans
 
     # -- compile accounting ------------------------------------------------
@@ -116,6 +120,26 @@ class DeviceStats:
         with self._lock:
             self._stalls[scope] = self._stalls.get(scope, 0) + 1
 
+    def note_verify_failure(self, scope: str) -> None:
+        with self._lock:
+            self._verify_failures[scope] = \
+                self._verify_failures.get(scope, 0) + 1
+
+    def note_restore_fallback(self, scope: str) -> None:
+        with self._lock:
+            self._restore_fallbacks[scope] = \
+                self._restore_fallbacks.get(scope, 0) + 1
+
+    @property
+    def verify_failures(self) -> int:
+        with self._lock:
+            return sum(self._verify_failures.values())
+
+    @property
+    def restore_fallbacks(self) -> int:
+        with self._lock:
+            return sum(self._restore_fallbacks.values())
+
     @property
     def watchdog_trips(self) -> int:
         with self._lock:
@@ -178,6 +202,10 @@ class DeviceStats:
                 "injected_faults_total": sum(self._injected.values()),
                 "watchdog_trips_total": sum(self._watchdog_trips.values()),
                 "stall_detections_total": sum(self._stalls.values()),
+                "checkpoint_verify_failures_total":
+                    sum(self._verify_failures.values()),
+                "restore_fallbacks_total":
+                    sum(self._restore_fallbacks.values()),
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -191,6 +219,10 @@ class DeviceStats:
                 out[f"watchdog.{site}"] = n
             for scope, n in sorted(self._stalls.items()):
                 out[f"stalls.{scope}"] = n
+            for scope, n in sorted(self._verify_failures.items()):
+                out[f"verify_failures.{scope}"] = n
+            for scope, n in sorted(self._restore_fallbacks.items()):
+                out[f"restore_fallbacks.{scope}"] = n
             return out
 
     def reset(self) -> None:
@@ -205,6 +237,8 @@ class DeviceStats:
             self._injected.clear()
             self._watchdog_trips.clear()
             self._stalls.clear()
+            self._verify_failures.clear()
+            self._restore_fallbacks.clear()
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -322,3 +356,8 @@ def bind_device_metrics(registry) -> None:
     # / flink_tpu_device_stall_detections_total)
     g.gauge("watchdog_trips_total", lambda: s.watchdog_trips)
     g.gauge("stall_detections_total", lambda: s.stall_detections)
+    # verified recovery (prometheus:
+    # flink_tpu_device_checkpoint_verify_failures_total /
+    # flink_tpu_device_restore_fallbacks_total)
+    g.gauge("checkpoint_verify_failures_total", lambda: s.verify_failures)
+    g.gauge("restore_fallbacks_total", lambda: s.restore_fallbacks)

@@ -20,6 +20,8 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     channel.backpressure  drop-style: a put reports "queue full" once
     checkpoint.write  persisting a completed checkpoint
     checkpoint.load   reading a checkpoint back for restore
+    checkpoint.corrupt   mutation-style: bit-flip a stored chunk file
+    checkpoint.truncate  mutation-style: truncate a stored chunk file
     rpc.heartbeat     drop-style: a worker heartbeat frame is lost
     rpc.send          a worker<->coordinator control frame send
     sink.invoke       delivering a batch to a sink function/writer
@@ -58,6 +60,7 @@ FAULT_SITES = (
     "transfer.h2d", "transfer.d2h",
     "channel.send", "channel.backpressure",
     "checkpoint.write", "checkpoint.load",
+    "checkpoint.corrupt", "checkpoint.truncate",
     "rpc.heartbeat", "rpc.send", "sink.invoke",
     "bench.probe",
 )

@@ -41,13 +41,42 @@ __all__ = ["ChangelogWriter", "FsChangelogStorage", "InMemoryChangelogStorage",
 
 @dataclass(frozen=True)
 class SegmentHandle:
-    """Reference to one immutable uploaded segment."""
+    """Reference to one immutable uploaded segment. ``digest`` carries a
+    blake2b checksum of the stored payload (the checkpoint-manifest
+    scheme extended to changelog artifacts); readers verify it and raise
+    CorruptArtifactError on mismatch. Empty for legacy handles and the
+    in-memory driver (whose payload never crosses a device boundary)."""
 
     segment_id: str
     from_seq: int
     to_seq: int
     driver: str                 # "fs" | "mem"
     location: str = ""          # fs: file path; mem: store key
+    digest: str = ""            # blake2b-128 hex of the stored payload
+
+
+def _segment_digest(payload: bytes) -> str:
+    import hashlib
+    return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+def _verified_segment_loads(data: bytes, digest: str, what: str) -> list:
+    """Unpickle a segment payload after checking its handle checksum —
+    a bit-flipped or truncated changelog segment must surface as a typed
+    CorruptArtifactError (→ restore fallback), never as garbage replay
+    records or a bare unpickling crash."""
+    from ..checkpoint.storage import CorruptArtifactError
+
+    if digest and _segment_digest(data) != digest:
+        raise CorruptArtifactError(
+            f"changelog segment {what} failed its checksum "
+            "(stored bytes do not match the handle digest)")
+    try:
+        return pickle.loads(data)
+    except Exception as e:  # noqa: BLE001 - truncated/garbled payload
+        raise CorruptArtifactError(
+            f"changelog segment {what} is undecodable "
+            f"({type(e).__name__}: {e})") from e
 
 
 class _Store:
@@ -88,16 +117,18 @@ class FsChangelogStorage(_Store):
         seg_id = uuid.uuid4().hex[:16]
         name = f"seg-{records[0][0]}-{seg_id}"
         path = os.path.join(self.dir, name)
+        payload = pickle.dumps(records, protocol=pickle.HIGHEST_PROTOCOL)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(records, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(payload)
         os.replace(tmp, path)
         return SegmentHandle(seg_id, records[0][0], records[-1][0],
-                             "fs", name)
+                             "fs", name, digest=_segment_digest(payload))
 
     def read_segment(self, handle: SegmentHandle) -> list:
         with open(self._resolve(handle.location), "rb") as f:
-            return pickle.load(f)
+            data = f.read()
+        return _verified_segment_loads(data, handle.digest, handle.location)
 
     def delete_segment(self, handle: SegmentHandle) -> None:
         try:
@@ -179,7 +210,8 @@ def read_any_segment(handle_dict: dict, root: Optional[str] = None) -> list:
     h = SegmentHandle(**handle_dict)
     if h.driver == "fs":
         with open(_resolve_any(h.location, root), "rb") as f:
-            return pickle.load(f)
+            data = f.read()
+        return _verified_segment_loads(data, h.digest, h.location)
     return InMemoryChangelogStorage().read_segment(h)
 
 
