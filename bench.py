@@ -209,7 +209,9 @@ def _collect_metrics(env, before: dict) -> dict:
     for k in ("device_retries_total", "device_degraded_total",
               "dead_letter_records_total", "injected_faults_total",
               "watchdog_trips_total", "stall_detections_total",
-              "checkpoint_verify_failures_total", "restore_fallbacks_total"):
+              "checkpoint_verify_failures_total", "restore_fallbacks_total",
+              "network_reconnects_total", "frames_deduped_total",
+              "zombies_fenced_total", "network_errors_total"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
@@ -371,7 +373,7 @@ CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
               "transfer.h2d=p0.05,transfer.d2h=every@5!hang@30,"
               "channel.send=once@3,channel.backpressure=every@17,"
               "checkpoint.write=once@1,sink.invoke=once@2,"
-              "rpc.heartbeat=every@5")
+              "rpc.heartbeat=every@5,net.sever=every@23")
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -998,7 +1000,13 @@ def chaos(seed: int) -> None:
            # artifact verification failures seen during the chaos run
            "restore_fallbacks": stages.get("restore_fallbacks_total", 0),
            "verify_failures": stages.get(
-               "checkpoint_verify_failures_total", 0)}
+               "checkpoint_verify_failures_total", 0),
+           # partition-tolerance surface: severed connections healed by
+           # replay, duplicate frames dropped, stale-epoch peers fenced
+           "net_reconnects": stages.get("network_reconnects_total", 0),
+           "frames_deduped": stages.get("frames_deduped_total", 0),
+           "zombies_fenced": stages.get("zombies_fenced_total", 0),
+           "net_errors": stages.get("network_errors_total", 0)}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in stages.items()})
     print(json.dumps(rec))

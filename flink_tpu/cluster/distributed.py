@@ -183,6 +183,28 @@ class _Coordinator:
             threading.Thread(target=self._serve_worker, args=(conn,),
                              name="coord-worker", daemon=True).start()
 
+    def _fence(self, conn: socket.socket, host_id, msg_epoch,
+               kind: str, terminal: bool = True) -> None:
+        """Reject a deposed attempt's control message with an explicit
+        ``fenced`` reply (reference JobMaster fencing tokens): the zombie
+        learns it lost ownership and cancels its local attempt instead of
+        retrying into the void. ``terminal=False`` marks an informational
+        fence for a stale message from a worker that is NOT blocklisted
+        (e.g. a pre-restart report racing the epoch bump) — the worker
+        must not cancel the attempt it is still a healthy member of."""
+        from ..metrics.device import DEVICE_STATS
+        DEVICE_STATS.note_zombie_fenced("coordinator")
+        with self._lock:
+            self.failure_history.append({
+                "timestamp": time.time(), "kind": "zombie-fenced",
+                "host": host_id, "epoch": msg_epoch,
+                "current_epoch": self.epoch, "message": kind})
+        try:
+            _send_msg(conn, {"type": "fenced", "epoch": self.epoch,
+                             "terminal": terminal})
+        except OSError:
+            pass
+
     def _serve_worker(self, conn: socket.socket) -> None:
         host_id = None
         try:
@@ -191,6 +213,14 @@ class _Coordinator:
                 if msg is None:
                     return
                 kind = msg["type"]
+                sender = msg.get("host_id", host_id)
+                if (sender is not None
+                        and self.resources.blocklist.is_blocked(sender)):
+                    # a blocklisted host is a deposed attempt by
+                    # definition: every message kind is fenced, and a
+                    # zombie re-registration never rejoins placement
+                    self._fence(conn, sender, msg.get("epoch"), kind)
+                    continue
                 if kind == "register":
                     host_id = msg["host_id"]
                     with self._lock:
@@ -239,7 +269,12 @@ class _Coordinator:
                                 "kind": "task-failure",
                                 "error": msg.get("error", "unknown")})
                     if stale:
-                        pass  # a previous attempt's report, already handled
+                        # a previous attempt's report, already handled —
+                        # answer with a non-terminal fence so the sender
+                        # can tell "ignored as stale" from a lost message
+                        self._fence(conn, msg["host_id"],
+                                    msg.get("epoch", 0), "failed",
+                                    terminal=False)
                     elif not self._maybe_restart(
                             [], f"task failure on host {msg['host_id']}: "
                                 f"{msg.get('error', 'unknown')}"):
@@ -301,11 +336,18 @@ class _Coordinator:
         return out
 
     def _on_ack(self, msg: dict) -> None:
+        # a zombie attempt's checkpoint ack must never complete a
+        # checkpoint for the current attempt (split-brain: its snapshots
+        # describe deposed state); the pending-ack table alone does not
+        # protect against this because checkpoint ids keep counting up
+        if msg.get("epoch", self.epoch) != self.epoch:
+            return
         cid = msg["checkpoint_id"]
         complete = None
         snapshots = self._canonical_snapshots(msg["host_id"],
                                               msg["snapshots"])
         with self._lock:
+            epoch = self.epoch
             if cid not in self._pending_acks:
                 return
             self._pending_acks[cid].update(snapshots)
@@ -338,9 +380,26 @@ class _Coordinator:
                         "error": f"{type(e).__name__}: {e}"})
                 return
             with self._lock:
+                if self.epoch != epoch:
+                    # a restart was arranged while this checkpoint was in
+                    # storage.store: the restore candidate was chosen
+                    # WITHOUT it, so completing it now would commit sink
+                    # output the restored attempt is about to replay —
+                    # discard the orphan instead of breaking exactly-once
+                    self.failure_history.append({
+                        "timestamp": time.time(), "checkpoint": cid,
+                        "kind": "checkpoint-superseded",
+                        "epoch": epoch, "current_epoch": self.epoch})
+                    return
                 self.completed.append(complete)
+            # stamped with the epoch CAPTURED at ack time (not re-read:
+            # a concurrent bump would stamp the new epoch and defeat the
+            # workers' gate) so a worker that restarted between the ack
+            # and this fan-out drops the notification instead of
+            # committing a deposed attempt's pending output
             self.broadcast({"type": "checkpoint_complete",
                             "checkpoint_id": cid,
+                            "epoch": epoch,
                             "savepoint": complete.is_savepoint})
 
     # -- failover ----------------------------------------------------------
@@ -571,6 +630,13 @@ class DistributedHost:
         # control-socket sends originate from the heartbeat thread, the
         # checkpoint listener AND the run loop: serialize the frames
         self._ctrl_lock = threading.Lock()
+        # partition tolerance: the attempt epoch this host is running
+        # (stamped on every outgoing control message so the coordinator
+        # can fence zombies), whether the coordinator fenced US, and a
+        # lock so the heartbeat and control threads don't both redial
+        self._epoch = 0
+        self.fenced = False
+        self._ctrl_reconnect_lock = threading.Lock()
 
     @property
     def data_address(self) -> tuple[str, int]:
@@ -602,6 +668,19 @@ class DistributedHost:
                 "only; the distributed SPMD deploy does not wire back "
                 "edges yet")
         job = LocalJob(jg, config)
+        # adopt the attempt epoch on the data plane: from here on the
+        # transport fences HELLOs from older (deposed) attempts
+        self._epoch = epoch
+        self.transport.set_epoch(epoch)
+        from ..core.config import NetworkOptions
+        net_kwargs = dict(
+            epoch=epoch,
+            reconnect_timeout=float(
+                config.get(NetworkOptions.RECONNECT_TIMEOUT)),
+            reconnect_backoff=float(
+                config.get(NetworkOptions.RECONNECT_BACKOFF)),
+            replay_capacity=int(
+                config.get(NetworkOptions.REPLAY_BUFFER)))
         aligned = config.get(CheckpointingOptions.MODE) == "exactly-once"
         live = live_hosts or list(range(self.n_hosts))
         schedule = (build_schedule({h: slots.get(h, 1) for h in live})
@@ -627,7 +706,7 @@ class DistributedHost:
                     elif s_here:
                         host, port = peer_data_addrs[place(d)]
                         channels[(ei, s, d)] = RemoteChannelSender(
-                            host, port, edge_key(ei, s, d))
+                            host, port, edge_key(ei, s, d), **net_kwargs)
                     elif d_here:
                         channels[(ei, s, d)] = self.transport.channel(
                             edge_key(ei, s, d))
@@ -810,11 +889,64 @@ class DistributedHost:
                     raise
                 time.sleep(0.1)
         self._ctrl_send({"type": "register", "host_id": self.host_id,
-                         "uids": self._uid_map(), "slots": self._my_slots()})
+                         "epoch": self._epoch, "uids": self._uid_map(),
+                         "slots": self._my_slots()})
         threading.Thread(target=self._control_loop, name="worker-control",
                          daemon=True).start()
         threading.Thread(target=self._heartbeat_loop,
                          name="worker-heartbeat", daemon=True).start()
+
+    def _reconnect_control(self, observed_sock,
+                           kind: str = "control-reconnect") -> bool:
+        """Redial the coordinator after a severed control socket, bounded
+        by ``net.reconnect-timeout`` (0 disables: fail fast into the
+        heartbeat-timeout failover). Re-registers on the new connection
+        so coordinator broadcasts flow to it. Returns False when the
+        caller should fall back to the old severed-connection behavior
+        (stop and let the coordinator's heartbeat window decide)."""
+        from ..core.config import NetworkOptions
+        from .transport import _note_net_event
+        with self._ctrl_reconnect_lock:
+            if self._ctrl is not observed_sock:
+                return True  # another thread already healed it
+            if (self._cancelled.is_set() or self.fenced
+                    or not self._coord_addr):
+                return False
+            timeout = float(self.config.get(NetworkOptions.RECONNECT_TIMEOUT))
+            if timeout <= 0:
+                return False
+            host, port = self._coord_addr.split(":")
+            net_deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    sock = socket.create_connection((host, int(port)),
+                                                    timeout=5.0)
+                    break
+                except OSError:
+                    if time.monotonic() >= net_deadline:
+                        from ..runtime.watchdog import WATCHDOG
+                        WATCHDOG.note_stall("net.reconnect", timeout,
+                                            scope=f"host{self.host_id}-ctrl")
+                        return False
+                    time.sleep(0.1)
+            with self._ctrl_lock:
+                old, self._ctrl = self._ctrl, sock
+            try:
+                old.close()
+            except OSError:
+                pass
+            try:
+                self._ctrl_send({"type": "register",
+                                 "host_id": self.host_id,
+                                 "epoch": self._epoch,
+                                 "uids": self._uid_map(),
+                                 "slots": self._my_slots()})
+            except (OSError, StallError):
+                return False
+            from ..metrics.device import DEVICE_STATS
+            DEVICE_STATS.note_net_reconnect("control")
+            _note_net_event(kind, host=self.host_id)
+            return True
 
     def _make_listener(self):
         acks: dict[int, dict] = {}
@@ -851,6 +983,7 @@ class DistributedHost:
                             del self._local_snapshots[old]
                     self._ctrl_send({
                         "type": "ack", "host_id": self.host_id,
+                        "epoch": self._epoch,
                         "checkpoint_id": cid,
                         "savepoint": pending[cid][1],
                         "snapshots": snaps})
@@ -858,88 +991,137 @@ class DistributedHost:
             else:
                 self._ctrl_send({"type": "decline",
                                  "host_id": self.host_id,
+                                 "epoch": self._epoch,
                                  "checkpoint_id": cid})
 
         return listener
 
     def _control_loop(self) -> None:
-        try:
-            while not self._cancelled.is_set():
-                msg = _recv_msg(self._ctrl)
-                if msg is None:
+        while not self._cancelled.is_set():
+            sock = self._ctrl
+            try:
+                msg = _recv_msg(sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                if self._cancelled.is_set() or self._all_done.is_set():
                     return
-                if msg["type"] == "trigger_checkpoint":
-                    cid = msg["checkpoint_id"]
-                    if (self.job is not None and not self._redeploying.is_set()
-                            and not self.job.tasks):
-                        # zero subtasks placed here (slot-weighted placement
-                        # can starve a host): ack with an empty snapshot so
-                        # the checkpoint never waits on us — this host is
-                        # "trivially done" but must not decline
-                        self._ctrl_send({"type": "ack",
-                                         "host_id": self.host_id,
-                                         "checkpoint_id": cid,
-                                         "savepoint": msg["savepoint"],
-                                         "snapshots": {}})
-                        continue
-                    if (self._redeploying.is_set() or self.job is None
-                            or self.job._done.is_set()):
-                        # mid-failover or already finished: this attempt
-                        # cannot snapshot — decline so the pending
-                        # checkpoint never waits on us forever
-                        self._ctrl_send({"type": "decline",
-                                         "host_id": self.host_id,
-                                         "checkpoint_id": cid})
-                        continue
-                    from ..core.elements import CheckpointBarrier
-                    self._pending_ckpts[cid] = (len(self.job.tasks),
-                                                msg["savepoint"])
-                    barrier = CheckpointBarrier(
-                        cid, is_savepoint=msg["savepoint"])
-                    for t in self.job.source_tasks.values():
-                        t.trigger_checkpoint(barrier)
-                elif msg["type"] == "checkpoint_complete":
-                    cid = msg["checkpoint_id"]
-                    # prune local-recovery copies on COMPLETION (reference
-                    # confirms checkpoints before pruning local state):
-                    # everything older than the newest completed cid can
-                    # never be restored
-                    if self._local_recovery:
-                        for old in [c for c in self._local_snapshots
-                                    if c < cid]:
-                            del self._local_snapshots[old]
-                    sp = msg.get("savepoint", False)
-                    for t in self.job.tasks.values():
-                        t.execute_in_mailbox(
-                            lambda t=t, c=cid, s=sp:
-                            t.chain.notify_checkpoint_complete(
-                                c, is_savepoint=s)
-                            if getattr(t, "chain", None) else None)
-                elif msg["type"] == "restart":
-                    with self._intent_lock:
-                        self._restart_intent = msg
-                    self._redeploying.set()
-                    self._restart_event.set()
-                    if self.job is not None:
-                        self.job.cancel()
-                elif msg["type"] == "wm_alignment":
-                    job = self.job
-                    if job is not None and not self._redeploying.is_set():
-                        job.watermark_alignment.set_remote_minima(
-                            msg["minima"])
-                elif msg["type"] == "all_done":
-                    self._all_done.set()
-                elif msg["type"] == "cancel":
-                    self._cancelled.set()
-                    if self.job is not None:
-                        self.job.cancel()
-        except (OSError, StallError):
-            pass
+                # severed control socket: heal it within the grace window
+                # instead of going silent until the heartbeat timeout
+                if not self._reconnect_control(sock):
+                    return
+                continue
+            try:
+                self._handle_control(msg)
+            except (OSError, StallError):
+                # a reply send failed; the recv above notices the severed
+                # socket on the next turn and runs the reconnect path
+                pass
+
+    def _handle_control(self, msg: dict) -> None:
+        if msg["type"] == "trigger_checkpoint":
+            cid = msg["checkpoint_id"]
+            if (self.job is not None and not self._redeploying.is_set()
+                    and not self.job.tasks):
+                # zero subtasks placed here (slot-weighted placement
+                # can starve a host): ack with an empty snapshot so
+                # the checkpoint never waits on us — this host is
+                # "trivially done" but must not decline
+                self._ctrl_send({"type": "ack",
+                                 "host_id": self.host_id,
+                                 "epoch": self._epoch,
+                                 "checkpoint_id": cid,
+                                 "savepoint": msg["savepoint"],
+                                 "snapshots": {}})
+                return
+            if (self._redeploying.is_set() or self.job is None
+                    or self.job._done.is_set()):
+                # mid-failover or already finished: this attempt
+                # cannot snapshot — decline so the pending
+                # checkpoint never waits on us forever
+                self._ctrl_send({"type": "decline",
+                                 "host_id": self.host_id,
+                                 "epoch": self._epoch,
+                                 "checkpoint_id": cid})
+                return
+            from ..core.elements import CheckpointBarrier
+            self._pending_ckpts[cid] = (len(self.job.tasks),
+                                        msg["savepoint"])
+            barrier = CheckpointBarrier(
+                cid, is_savepoint=msg["savepoint"])
+            for t in self.job.source_tasks.values():
+                t.trigger_checkpoint(barrier)
+        elif msg["type"] == "checkpoint_complete":
+            # epoch-gated: a notification for a DEPOSED attempt (this
+            # host restarted between its ack and the fan-out, or a
+            # zombie window under split-brain) must not commit pending
+            # output — duplicate/foreign commits break exactly-once
+            if (msg.get("epoch", self._epoch) != self._epoch
+                    or self._redeploying.is_set() or self.job is None):
+                return
+            cid = msg["checkpoint_id"]
+            # prune local-recovery copies on COMPLETION (reference
+            # confirms checkpoints before pruning local state):
+            # everything older than the newest completed cid can
+            # never be restored
+            if self._local_recovery:
+                for old in [c for c in self._local_snapshots
+                            if c < cid]:
+                    del self._local_snapshots[old]
+            sp = msg.get("savepoint", False)
+            for t in self.job.tasks.values():
+                t.execute_in_mailbox(
+                    lambda t=t, c=cid, s=sp:
+                    t.chain.notify_checkpoint_complete(
+                        c, is_savepoint=s)
+                    if getattr(t, "chain", None) else None)
+        elif msg["type"] == "restart":
+            with self._intent_lock:
+                self._restart_intent = msg
+            self._redeploying.set()
+            self._restart_event.set()
+            if self.job is not None:
+                self.job.cancel()
+        elif msg["type"] == "wm_alignment":
+            job = self.job
+            if job is not None and not self._redeploying.is_set():
+                job.watermark_alignment.set_remote_minima(
+                    msg["minima"])
+        elif msg["type"] == "fenced":
+            # the coordinator deposed this attempt (zombie fencing):
+            # record it; a TERMINAL fence cancels the local attempt so
+            # a split-brain worker stops producing instead of running
+            # to completion on stale membership
+            from ..metrics.device import DEVICE_STATS
+            from .transport import _note_net_event
+            self.fenced = True
+            DEVICE_STATS.note_zombie_fenced("worker")
+            _note_net_event("zombie-fenced", host=self.host_id,
+                            epoch=self._epoch,
+                            coordinator_epoch=msg.get("epoch"))
+            if msg.get("terminal", True):
+                self._cancelled.set()
+                if self.job is not None:
+                    self.job.cancel()
+        elif msg["type"] == "all_done":
+            self._all_done.set()
+        elif msg["type"] == "cancel":
+            self._cancelled.set()
+            if self.job is not None:
+                self.job.cancel()
 
     def _heartbeat_loop(self) -> None:
         from ..runtime.faults import FAULTS
         interval = self.config.get(RuntimeOptions.HEARTBEAT_INTERVAL)
         while not self._cancelled.is_set():
+            if FAULTS.enabled and FAULTS.check("net.zombie"):
+                # zombie drill: this host looks dead to the coordinator
+                # (no beats) while its data plane keeps flowing — the
+                # check must come BEFORE the send so the reconnect
+                # reflex below never fires either (a zombie does not
+                # notice it was partitioned)
+                time.sleep(interval)
+                continue
             if FAULTS.enabled and FAULTS.check("rpc.heartbeat"):
                 # drop-style fault site: this beat is lost on the wire;
                 # enough consecutive drops and the coordinator declares
@@ -950,14 +1132,21 @@ class DistributedHost:
             job = self.job
             minima = (job.watermark_alignment.local_minima()
                       if job is not None else {})
+            sock = self._ctrl
             try:
                 self._ctrl_send({"type": "heartbeat",
                                  "host_id": self.host_id,
+                                 "epoch": self._epoch,
                                  "wm_minima": minima})
             except (OSError, StallError):
-                # a stalled control socket is a severed one: stop beating,
-                # let the coordinator's heartbeat timeout take over
-                return
+                # a stalled control socket is a severed one: attempt ONE
+                # immediate reconnect inside the grace window before
+                # falling back to the coordinator's heartbeat-timeout
+                # failover (emits a heartbeat-reconnect event on success)
+                if not self._reconnect_control(sock,
+                                               kind="heartbeat-reconnect"):
+                    return
+                continue
             time.sleep(interval)
 
     # -- run ---------------------------------------------------------------
@@ -1116,6 +1305,7 @@ class DistributedHost:
                     # announce readiness for the new attempt
                     self._ctrl_send({"type": "register",
                                      "host_id": self.host_id,
+                                     "epoch": self._epoch,
                                      "uids": self._uid_map(),
                                      "slots": self._my_slots()})
                 job.start()

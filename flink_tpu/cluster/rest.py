@@ -118,6 +118,11 @@ class RestEndpoint:
                                 "error": s.get("error")})
         from ..runtime.watchdog import WATCHDOG
         entries.extend(dict(e) for e in WATCHDOG.events)
+        # transport-plane events (reconnects, fenced zombies, socket
+        # errors the accept/receive/credit paths used to swallow): the
+        # operator diagnosing a flapping partition sees them here
+        from .transport import NET_EVENTS
+        entries.extend(dict(e) for e in NET_EVENTS)
         entries.sort(key=lambda e: e.get("timestamp") or 0, reverse=True)
         return {"name": name, "entries": entries}
 

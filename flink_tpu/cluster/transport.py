@@ -1,4 +1,5 @@
-"""Inter-host data plane: TCP channels with credit-based flow control.
+"""Inter-host data plane: TCP channels with credit-based flow control
+and partition-tolerant, sequence-numbered delivery.
 
 Analog of the reference's Netty network stack (flink-runtime
 io/network/netty/: NettyServer/NettyClient, PartitionRequestQueue,
@@ -9,14 +10,29 @@ XLA collectives over ICI (parallel/), while cross-host dataflow edges carry
 serialized columnar batches over TCP behind the same Channel interface the
 local runtime uses — tasks cannot tell local and remote edges apart.
 
+A TCP connection's life is decoupled from the logical edge's: every data
+frame carries a monotone sequence number, the receiver acknowledges
+delivery, and the sender keeps unacked frames in a bounded replay buffer.
+On socket death the sender reconnects with backoff under the
+``net.reconnect-timeout`` deadline, re-HELLOs with (channel key, attempt
+epoch, last-acked seq), and replays the buffer; the receiver dedups
+already-delivered frames by sequence number — a severed-and-restored
+connection is exactly-once with ZERO region restarts. Only deadline
+expiry escalates into the StallError -> region-restart ladder. A HELLO
+whose attempt epoch is older than the server's is answered with FENCED:
+the zombie attempt's sends fail with :class:`FencedError` instead of
+feeding a deposed job's data into the new attempt.
+
 Wire protocol (little-endian, length-prefixed):
     frame   := u32 length, u8 type, payload
-    HELLO   := channel key (utf-8)         -- sender registers its edge
-    BATCH   := serialize_batch bytes       -- one RecordBatch
-    CONTROL := pickled stream element      -- watermark/barrier/end-of-input
-    CREDIT  := u32 n                       -- receiver grants n more sends
+    HELLO   := u64 epoch, u64 last-acked seq, channel key (utf-8)
+    BATCH   := u64 seq, serialize_batch bytes   -- one RecordBatch
+    CONTROL := u64 seq, pickled stream element  -- watermark/barrier/eoi
+    CREDIT  := u32 n          -- receiver grants n more sends
+    ACK     := u64 seq        -- receiver: delivered through seq
+    FENCED  := u64 epoch      -- receiver: sender's attempt is deposed
 
-Each logical edge (edge id, src subtask, dst subtask) is one TCP connection;
+Each logical edge (edge id, src subtask, dst subtask) is one connection;
 the receiver grants ``INITIAL_CREDITS`` up front and re-grants as the task
 drains its queue, so a slow consumer stalls exactly its upstream producer —
 the same per-channel backpressure story as the reference's credit loop.
@@ -30,21 +46,51 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 from ..core.records import RecordBatch
 from ..core.serializers import deserialize_batch, serialize_batch
 from ..runtime.channels import Channel
 
-__all__ = ["RemoteChannelSender", "TransportServer", "INITIAL_CREDITS"]
+__all__ = ["RemoteChannelSender", "TransportServer", "INITIAL_CREDITS",
+           "FencedError", "NET_EVENTS"]
 
 INITIAL_CREDITS = 32
 
 _LEN = struct.Struct("<I")
+_SEQ = struct.Struct("<Q")
+_HELLO = struct.Struct("<QQ")
 _TYPE_HELLO = 0
 _TYPE_BATCH = 1
 _TYPE_CONTROL = 2
 _TYPE_CREDIT = 3
+_TYPE_ACK = 4
+_TYPE_FENCED = 5
+
+#: Bounded transport event log (reconnects, fenced peers, socket errors
+#: that used to be silently swallowed), merged into REST
+#: ``/jobs/<name>/exceptions`` alongside the watchdog's stall events.
+NET_EVENTS: deque = deque(maxlen=256)
+
+
+def _note_net_event(kind: str, **fields) -> None:
+    e = {"timestamp": time.time(), "kind": kind}
+    e.update(fields)
+    NET_EVENTS.append(e)
+
+
+def _note_net_error(direction: str, err: BaseException, **fields) -> None:
+    from ..metrics.device import DEVICE_STATS
+    DEVICE_STATS.note_net_error(direction)
+    _note_net_event("network-error", direction=direction,
+                    error=f"{type(err).__name__}: {err}", **fields)
+
+
+class FencedError(ConnectionError):
+    """The peer rejected this sender's attempt epoch: a newer execution
+    attempt owns the job, so the deposed (zombie) attempt must cancel —
+    retrying or reconnecting cannot help."""
 
 
 def _send_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
@@ -74,39 +120,139 @@ def _recv_frame(sock: socket.socket) -> Optional[tuple[int, bytes]]:
 
 class RemoteChannelSender(Channel):
     """Producer end of a cross-host edge (the RemoteInputChannel's upstream
-    counterpart): serializes elements, spends credits, blocks without."""
+    counterpart): serializes elements, spends credits, blocks without.
+
+    Self-healing: sequence-numbers every frame into a bounded replay
+    buffer and survives socket death by reconnecting under the
+    ``net.reconnect-timeout`` deadline — see the module docstring for the
+    resume protocol. ``connect_timeout`` is accepted as a legacy alias
+    for ``reconnect_timeout``."""
 
     def __init__(self, host: str, port: int, channel_key: str,
-                 connect_timeout: float = 30.0):
-        deadline = time.time() + connect_timeout
-        last_err: Optional[Exception] = None
-        while True:
-            try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=5.0)
-                break
-            except OSError as e:  # receiver may not be up yet
-                last_err = e
-                if time.time() >= deadline:
-                    raise ConnectionError(
-                        f"cannot reach {host}:{port} for {channel_key}"
-                    ) from last_err
-                time.sleep(0.1)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._key = channel_key
-        self._credits = threading.Semaphore(0)
-        self._closed = threading.Event()
-        _send_frame(self._sock, _TYPE_HELLO, channel_key.encode())
-        self._reader = threading.Thread(target=self._credit_loop,
-                                        name=f"credits-{channel_key}",
-                                        daemon=True)
-        self._reader.start()
+                 connect_timeout: Optional[float] = None,
+                 epoch: int = 0,
+                 reconnect_timeout: Optional[float] = None,
+                 reconnect_backoff: float = 0.05,
+                 replay_capacity: int = 1024):
+        from ..runtime.watchdog import WATCHDOG
 
-    def _credit_loop(self) -> None:
+        self._addr = (host, port)
+        self._key = channel_key
+        self._epoch = int(epoch)
+        if reconnect_timeout is None:
+            reconnect_timeout = connect_timeout
+        if reconnect_timeout is None:
+            reconnect_timeout = WATCHDOG.deadline_for("net.reconnect")
+        self._reconnect_timeout = float(reconnect_timeout)
+        self._backoff = float(reconnect_backoff)
+        self._replay_capacity = int(replay_capacity)
+        self._credits = threading.Semaphore(0)
+        self._closed = threading.Event()     # explicit close() only
+        self._fenced = threading.Event()
+        self._peer_epoch: Optional[int] = None
+        # _io_lock guards the socket writes, the replay buffer and the
+        # connection generation; _conn_lock serializes whole (re)connect
+        # procedures so racing threads don't each dial the peer
+        self._io_lock = threading.RLock()
+        self._conn_lock = threading.Lock()
+        self._gen = 0            # bumped per established connection
+        self._conn_dead = True
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0            # last assigned sequence number
+        self._acked = 0          # highest seq the receiver confirmed
+        self._buffer: deque = deque()  # unacked (seq, ftype, payload)
+        self.reconnects = 0      # observability (tests/bench)
+        self.replayed_frames = 0
+        # the INITIAL connect is bounded by the same net.reconnect
+        # deadline as every later reconnect (it used to spin on a
+        # hard-coded window) and raises the same typed StallError
+        self._reconnect(observed_gen=0, initial=True)
+
+    # -- connection lifecycle ---------------------------------------------
+    def _raise_if_dead(self) -> None:
+        if self._closed.is_set():
+            raise ConnectionError(f"remote channel {self._key} closed")
+        if self._fenced.is_set():
+            raise FencedError(
+                f"remote channel {self._key} fenced: attempt epoch "
+                f"{self._epoch} deposed by peer epoch {self._peer_epoch}")
+
+    def _reconnect(self, observed_gen: int, initial: bool = False) -> None:
+        """(Re)establish the connection, re-HELLO with (key, epoch,
+        last-acked seq) and replay every unacked frame. The loser of a
+        connect race returns once the winner's generation is live.
+        Bounded by ``net.reconnect-timeout``; expiry raises the typed
+        StallError that feeds the existing region-restart ladder. A zero
+        deadline disables reconnection of an ESTABLISHED connection
+        (fail fast into the ladder) but still allows the initial
+        connect its one attempt."""
+        from ..runtime.faults import FAULTS, InjectedFault
+        from ..runtime.watchdog import WATCHDOG
+
+        with self._conn_lock:
+            with self._io_lock:
+                if self._gen > observed_gen and not self._conn_dead:
+                    return  # another thread already healed it
+            self._raise_if_dead()
+            if not initial and self._reconnect_timeout <= 0:
+                raise WATCHDOG.note_stall(
+                    "net.reconnect", self._reconnect_timeout,
+                    scope=self._key)
+            deadline = time.monotonic() + self._reconnect_timeout
+            attempts = 0
+            while True:
+                self._raise_if_dead()
+                attempts += 1
+                try:
+                    if FAULTS.enabled:
+                        FAULTS.fire("net.connect")
+                    sock = socket.create_connection(self._addr, timeout=5.0)
+                    break
+                except (OSError, InjectedFault):
+                    if time.monotonic() >= deadline:
+                        raise WATCHDOG.note_stall(
+                            "net.reconnect", self._reconnect_timeout,
+                            scope=self._key)
+                    time.sleep(self._backoff)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._io_lock:
+                self._sock = sock
+                self._gen += 1
+                gen = self._gen
+                self._conn_dead = False
+                # stale credits belong to the dead connection's window;
+                # the new connection re-grants from scratch
+                while self._credits.acquire(blocking=False):
+                    pass
+                _send_frame(sock, _TYPE_HELLO,
+                            _HELLO.pack(self._epoch, self._acked)
+                            + self._key.encode())
+                replay = list(self._buffer)
+                for seq, ftype, payload in replay:
+                    _send_frame(sock, ftype, _SEQ.pack(seq) + payload)
+                self.replayed_frames += len(replay)
+            if not initial:
+                from ..metrics.device import DEVICE_STATS
+                self.reconnects += 1
+                DEVICE_STATS.note_net_reconnect("data")
+                _note_net_event("network-reconnect", channel=self._key,
+                                attempts=attempts, replayed=len(replay))
+            threading.Thread(target=self._receive_loop, args=(sock, gen),
+                             name=f"credits-{self._key}",
+                             daemon=True).start()
+
+    def _mark_dead(self, gen: int) -> None:
+        with self._io_lock:
+            if self._gen == gen:
+                self._conn_dead = True
+
+    def _receive_loop(self, sock: socket.socket, gen: int) -> None:
+        """Per-connection reader: credits, delivery acks (prune the
+        replay buffer), and the fencing verdict."""
         try:
             while not self._closed.is_set():
-                frame = _recv_frame(self._sock)
+                frame = _recv_frame(sock)
                 if frame is None:
                     break
                 ftype, payload = frame
@@ -114,25 +260,103 @@ class RemoteChannelSender(Channel):
                     (n,) = _LEN.unpack(payload)
                     for _ in range(n):
                         self._credits.release()
+                elif ftype == _TYPE_ACK:
+                    (seq,) = _SEQ.unpack(payload)
+                    with self._io_lock:
+                        if seq > self._acked:
+                            self._acked = seq
+                        while (self._buffer
+                               and self._buffer[0][0] <= self._acked):
+                            self._buffer.popleft()
+                elif ftype == _TYPE_FENCED:
+                    (peer_epoch,) = _SEQ.unpack(payload)
+                    self._peer_epoch = peer_epoch
+                    self._fenced.set()
+                    break
         except OSError:
             pass
         finally:
-            self._closed.set()
-            # unblock any waiting put() so the task sees the broken pipe
+            self._mark_dead(gen)
+            # unblock any waiting put() so the task notices the break
             self._credits.release()
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._heal_tail(gen)
 
+    def _heal_tail(self, gen: int) -> None:
+        """Unacked frames with no future put() to carry them (a sever
+        right after the last frame of the stream) are re-delivered from
+        here; failures stay best-effort — a later put escalates, and a
+        receiver starved of its tail hits task-progress supervision."""
+        from ..runtime.watchdog import StallError
+
+        if self._closed.is_set() or self._fenced.is_set():
+            return
+        with self._io_lock:
+            pending = bool(self._buffer)
+        if not pending:
+            return
+        try:
+            self._reconnect(gen)
+        except (ConnectionError, StallError):
+            pass
+
+    # -- the Channel surface ----------------------------------------------
     def put(self, element: Any, timeout: Optional[float] = None) -> bool:
+        from ..runtime.faults import FAULTS
+
         if not self._credits.acquire(timeout=timeout):
             return False  # no credit: backpressure
-        if self._closed.is_set():
-            raise ConnectionError(f"remote channel {self._key} closed")
+        self._raise_if_dead()
         if isinstance(element, RecordBatch):
-            _send_frame(self._sock, _TYPE_BATCH, serialize_batch(element))
+            ftype, payload = _TYPE_BATCH, serialize_batch(element)
         else:
-            _send_frame(self._sock, _TYPE_CONTROL,
-                        pickle.dumps(element,
-                                     protocol=pickle.HIGHEST_PROTOCOL))
-        return True
+            ftype, payload = _TYPE_CONTROL, pickle.dumps(
+                element, protocol=pickle.HIGHEST_PROTOCOL)
+        with self._io_lock:
+            if len(self._buffer) >= self._replay_capacity:
+                # credits bound in-flight frames far below this: an
+                # overflowing buffer means the receiver stopped acking
+                raise ConnectionError(
+                    f"remote channel {self._key}: replay buffer overflow "
+                    f"({len(self._buffer)} unacked frames)")
+            self._seq += 1
+            seq = self._seq
+            self._buffer.append((seq, ftype, payload))
+        wire = _SEQ.pack(seq) + payload
+        while True:
+            with self._io_lock:
+                gen = self._gen
+                dead = self._conn_dead
+                sock = self._sock
+            if not dead:
+                if FAULTS.enabled:
+                    FAULTS.check("net.delay")  # !hang@MS: wire latency
+                    if FAULTS.check("net.sever"):
+                        # deterministic partition drill: kill the
+                        # established socket under the send below
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                try:
+                    with self._io_lock:
+                        if self._gen == gen and not self._conn_dead:
+                            _send_frame(self._sock, ftype, wire)
+                            return True
+                    # the connection turned over underneath us: the
+                    # winner's replay already carried this frame
+                    return True
+                except OSError as e:
+                    _note_net_error("send", e, channel=self._key)
+                    self._mark_dead(gen)
+            self._raise_if_dead()
+            # reconnect replays the buffer — including the frame staged
+            # above — so a successful heal IS a successful put
+            self._reconnect(gen)
+            return True
 
     def poll(self) -> Optional[Any]:
         raise RuntimeError("sender side of a remote channel cannot poll")
@@ -140,24 +364,44 @@ class RemoteChannelSender(Channel):
     def size(self) -> int:
         return 0
 
+    @property
+    def unacked(self) -> int:
+        with self._io_lock:
+            return len(self._buffer)
+
     def close(self) -> None:
         self._closed.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        with self._io_lock:
+            sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class _ReceiverChannel(Channel):
     """Consumer end: a local queue fed by the transport server; polling
-    grants credits back upstream."""
+    grants credits back upstream. Survives connections: ``last_seq``
+    persists across reconnects so replayed frames dedup here."""
 
     def __init__(self, grant: Callable[[int], None]):
         self._q: queue.Queue = queue.Queue()
         self._grant = grant
+        self._seq_lock = threading.Lock()
+        self.last_seq = 0   # highest delivered sequence number
+        self.deduped = 0    # replayed frames dropped as already-delivered
 
-    def _enqueue(self, element: Any) -> None:
-        self._q.put(element)
+    def _deliver(self, seq: int, element: Any) -> bool:
+        """Enqueue iff this sequence number was not already delivered
+        (exactly-once across reconnects); returns whether it was."""
+        with self._seq_lock:
+            if seq <= self.last_seq:
+                self.deduped += 1
+                return False
+            self.last_seq = seq
+            self._q.put(element)
+            return True
 
     def put(self, element: Any, timeout: Optional[float] = None) -> bool:
         raise RuntimeError("receiver side of a remote channel cannot put")
@@ -177,13 +421,18 @@ class _ReceiverChannel(Channel):
 class TransportServer:
     """Per-host data-plane server (reference NettyServer +
     PartitionRequestServerHandler): accepts one connection per incoming
-    edge, demuxes by channel key into receiver channels."""
+    edge, demuxes by channel key into receiver channels. Tracks the
+    current attempt ``epoch`` (set by each deploy): a HELLO from an
+    older epoch is a zombie attempt's data plane and is answered with an
+    explicit FENCED frame instead of being served."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 initial_credits: int = INITIAL_CREDITS):
+                 initial_credits: int = INITIAL_CREDITS, epoch: int = 0):
         self._initial_credits = initial_credits
         self._channels: dict[str, _ReceiverChannel] = {}
         self._lock = threading.Lock()
+        self._epoch = int(epoch)
+        self.fenced_peers = 0
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -194,6 +443,12 @@ class TransportServer:
                                                name="transport-accept",
                                                daemon=True)
         self._accept_thread.start()
+
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt a new attempt epoch (each deploy): HELLOs from older
+        epochs are fenced from here on."""
+        with self._lock:
+            self._epoch = max(self._epoch, int(epoch))
 
     def channel(self, channel_key: str) -> Channel:
         """The local Channel for an incoming edge; register before (or
@@ -209,8 +464,14 @@ class TransportServer:
         while not self._stop.is_set():
             try:
                 conn, _addr = self._srv.accept()
-            except OSError:
-                return
+            except OSError as e:
+                if self._stop.is_set():
+                    return
+                # not the shutdown path: count it, surface it on the
+                # exceptions endpoint, and keep accepting
+                _note_net_error("accept", e)
+                time.sleep(0.05)
+                continue
             threading.Thread(target=self._serve_conn, args=(conn,),
                              name="transport-conn", daemon=True).start()
 
@@ -218,19 +479,44 @@ class TransportServer:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_lock = threading.Lock()
 
+        def reply(ftype: int, payload: bytes) -> None:
+            with send_lock:
+                _send_frame(conn, ftype, payload)
+
         def grant(n: int) -> None:
             try:
-                with send_lock:
-                    _send_frame(conn, _TYPE_CREDIT, _LEN.pack(n))
-            except OSError:
-                pass
+                reply(_TYPE_CREDIT, _LEN.pack(n))
+            except OSError as e:
+                # the task keeps draining its queue after the sender's
+                # socket died; grants toward a dead connection are
+                # expected during a reconnect window — count, don't spam
+                _note_net_error("credit", e, channel=key)
 
         channel: Optional[_ReceiverChannel] = None
+        key: Optional[str] = None
         try:
             frame = _recv_frame(conn)
             if frame is None or frame[0] != _TYPE_HELLO:
                 return
-            key = frame[1].decode()
+            payload = frame[1]
+            peer_epoch, _peer_acked = _HELLO.unpack(payload[:_HELLO.size])
+            key = payload[_HELLO.size:].decode()
+            with self._lock:
+                epoch = self._epoch
+            if peer_epoch < epoch:
+                # a deposed attempt's data plane: explicit fence so the
+                # zombie cancels instead of retrying into the void
+                from ..metrics.device import DEVICE_STATS
+                with self._lock:
+                    self.fenced_peers += 1
+                DEVICE_STATS.note_zombie_fenced("transport")
+                _note_net_event("zombie-fenced", channel=key,
+                                peer_epoch=peer_epoch, epoch=epoch)
+                try:
+                    reply(_TYPE_FENCED, _SEQ.pack(epoch))
+                except OSError:
+                    pass
+                return
             with self._lock:
                 channel = self._channels.get(key)
                 if channel is None:
@@ -238,18 +524,31 @@ class TransportServer:
                     self._channels[key] = channel
                 else:
                     channel._grant = grant
+            # resume point: a reconnecting sender prunes its replay
+            # buffer up to what was already delivered
+            reply(_TYPE_ACK, _SEQ.pack(channel.last_seq))
             grant(self._initial_credits)
             while not self._stop.is_set():
                 frame = _recv_frame(conn)
                 if frame is None:
                     return
                 ftype, payload = frame
-                if ftype == _TYPE_BATCH:
-                    channel._enqueue(deserialize_batch(payload))
-                elif ftype == _TYPE_CONTROL:
-                    channel._enqueue(pickle.loads(payload))
-        except OSError:
-            pass
+                if ftype not in (_TYPE_BATCH, _TYPE_CONTROL):
+                    continue
+                (seq,) = _SEQ.unpack(payload[:_SEQ.size])
+                body = payload[_SEQ.size:]
+                element = (deserialize_batch(body) if ftype == _TYPE_BATCH
+                           else pickle.loads(body))
+                if channel._deliver(seq, element):
+                    reply(_TYPE_ACK, _SEQ.pack(seq))
+                else:
+                    from ..metrics.device import DEVICE_STATS
+                    DEVICE_STATS.note_frame_deduped(key)
+                    # ack the high-water mark anyway so the sender prunes
+                    reply(_TYPE_ACK, _SEQ.pack(channel.last_seq))
+        except OSError as e:
+            if not self._stop.is_set():
+                _note_net_error("receive", e, channel=key)
         finally:
             try:
                 conn.close()

@@ -57,6 +57,14 @@ class DeviceStats:
         # verification failures and restore fallbacks per scope
         self._verify_failures: dict[str, int] = {}
         self._restore_fallbacks: dict[str, int] = {}
+        # partition-tolerance accounting (PR 5): channel reconnects per
+        # scope (data/control), replayed frames deduped at the receiver,
+        # stale-epoch peers fenced, and swallowed-no-longer socket
+        # errors per direction (accept/receive/credit/send)
+        self._net_reconnects: dict[str, int] = {}
+        self._frames_deduped: dict[str, int] = {}
+        self._zombies_fenced: dict[str, int] = {}
+        self._net_errors: dict[str, int] = {}
         self._tracer = None  # optional Tracer receiving Compile spans
 
     # -- compile accounting ------------------------------------------------
@@ -129,6 +137,47 @@ class DeviceStats:
         with self._lock:
             self._restore_fallbacks[scope] = \
                 self._restore_fallbacks.get(scope, 0) + 1
+
+    # -- partition-tolerance accounting --------------------------------------
+    def note_net_reconnect(self, scope: str) -> None:
+        with self._lock:
+            self._net_reconnects[scope] = \
+                self._net_reconnects.get(scope, 0) + 1
+
+    def note_frame_deduped(self, scope: str, n: int = 1) -> None:
+        with self._lock:
+            self._frames_deduped[scope] = \
+                self._frames_deduped.get(scope, 0) + n
+
+    def note_zombie_fenced(self, scope: str) -> None:
+        with self._lock:
+            self._zombies_fenced[scope] = \
+                self._zombies_fenced.get(scope, 0) + 1
+
+    def note_net_error(self, direction: str) -> None:
+        with self._lock:
+            self._net_errors[direction] = \
+                self._net_errors.get(direction, 0) + 1
+
+    @property
+    def net_reconnects(self) -> int:
+        with self._lock:
+            return sum(self._net_reconnects.values())
+
+    @property
+    def frames_deduped(self) -> int:
+        with self._lock:
+            return sum(self._frames_deduped.values())
+
+    @property
+    def zombies_fenced(self) -> int:
+        with self._lock:
+            return sum(self._zombies_fenced.values())
+
+    @property
+    def net_errors(self) -> int:
+        with self._lock:
+            return sum(self._net_errors.values())
 
     @property
     def verify_failures(self) -> int:
@@ -206,6 +255,13 @@ class DeviceStats:
                     sum(self._verify_failures.values()),
                 "restore_fallbacks_total":
                     sum(self._restore_fallbacks.values()),
+                "network_reconnects_total":
+                    sum(self._net_reconnects.values()),
+                "frames_deduped_total":
+                    sum(self._frames_deduped.values()),
+                "zombies_fenced_total":
+                    sum(self._zombies_fenced.values()),
+                "network_errors_total": sum(self._net_errors.values()),
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -223,6 +279,14 @@ class DeviceStats:
                 out[f"verify_failures.{scope}"] = n
             for scope, n in sorted(self._restore_fallbacks.items()):
                 out[f"restore_fallbacks.{scope}"] = n
+            for scope, n in sorted(self._net_reconnects.items()):
+                out[f"net_reconnects.{scope}"] = n
+            for scope, n in sorted(self._frames_deduped.items()):
+                out[f"frames_deduped.{scope}"] = n
+            for scope, n in sorted(self._zombies_fenced.items()):
+                out[f"zombies_fenced.{scope}"] = n
+            for direction, n in sorted(self._net_errors.items()):
+                out[f"net_errors.{direction}"] = n
             return out
 
     def reset(self) -> None:
@@ -239,6 +303,10 @@ class DeviceStats:
             self._stalls.clear()
             self._verify_failures.clear()
             self._restore_fallbacks.clear()
+            self._net_reconnects.clear()
+            self._frames_deduped.clear()
+            self._zombies_fenced.clear()
+            self._net_errors.clear()
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -361,3 +429,12 @@ def bind_device_metrics(registry) -> None:
     # flink_tpu_device_restore_fallbacks_total)
     g.gauge("checkpoint_verify_failures_total", lambda: s.verify_failures)
     g.gauge("restore_fallbacks_total", lambda: s.restore_fallbacks)
+    # partition tolerance (prometheus:
+    # flink_tpu_device_network_reconnects_total /
+    # flink_tpu_device_frames_deduped_total /
+    # flink_tpu_device_zombies_fenced_total /
+    # flink_tpu_device_network_errors_total)
+    g.gauge("network_reconnects_total", lambda: s.net_reconnects)
+    g.gauge("frames_deduped_total", lambda: s.frames_deduped)
+    g.gauge("zombies_fenced_total", lambda: s.zombies_fenced)
+    g.gauge("network_errors_total", lambda: s.net_errors)

@@ -26,6 +26,16 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     rpc.send          a worker<->coordinator control frame send
     sink.invoke       delivering a batch to a sink function/writer
     bench.probe       the bench backend-availability probe
+    net.connect       establishing (or re-establishing) a data-plane
+                      TCP connection — a trip is one failed attempt,
+                      absorbed by the reconnect loop's deadline
+    net.sever         drop-style: kill the established socket out from
+                      under a data-plane send (simulated TCP RST)
+    net.delay         drop-style: data-plane send latency — use !hang@MS
+                      (a trip without the hang flag is a no-op)
+    net.zombie        drop-style: suppress a worker's heartbeats AND its
+                      control-reconnect reflex while tasks and data keep
+                      flowing (the partitioned-but-alive split-brain)
 
 Every rule also accepts a ``!hang@MS`` flag: the trip SLEEPS MS
 milliseconds at the site instead of raising — the deterministic stand-in
@@ -63,6 +73,7 @@ FAULT_SITES = (
     "checkpoint.corrupt", "checkpoint.truncate",
     "rpc.heartbeat", "rpc.send", "sink.invoke",
     "bench.probe",
+    "net.connect", "net.sever", "net.delay", "net.zombie",
 )
 
 

@@ -118,6 +118,14 @@ class Watchdog:
         "bench.probe": "PROBE_TIMEOUT",
     }
 
+    #: sites whose deadline reads from NetworkOptions instead (net.*
+    #: keys live beside the other networking options; note the inverted
+    #: zero convention — net.reconnect-timeout=0 DISABLES reconnection
+    #: rather than unbounding it, enforced by the transport itself)
+    _NET_SITE_OPTIONS = {
+        "net.reconnect": "RECONNECT_TIMEOUT",
+    }
+
     def __init__(self):
         self._lock = threading.Lock()
         self.enabled = True
@@ -132,15 +140,19 @@ class Watchdog:
 
     @staticmethod
     def _default_deadlines() -> dict[str, float]:
-        from ..core.config import WatchdogOptions
+        from ..core.config import NetworkOptions, WatchdogOptions
 
-        return {site: getattr(WatchdogOptions, attr).default
-                for site, attr in Watchdog._SITE_OPTIONS.items()}
+        out = {site: getattr(WatchdogOptions, attr).default
+               for site, attr in Watchdog._SITE_OPTIONS.items()}
+        out.update({site: getattr(NetworkOptions, attr).default
+                    for site, attr in Watchdog._NET_SITE_OPTIONS.items()})
+        return out
 
     # -- configuration ---------------------------------------------------
     def configure(self, config) -> None:
-        """Adopt ``watchdog.*`` keys from a job Configuration."""
-        from ..core.config import WatchdogOptions
+        """Adopt ``watchdog.*`` (and the ``net.reconnect`` site's
+        ``net.*``) keys from a job Configuration."""
+        from ..core.config import NetworkOptions, WatchdogOptions
 
         with self._lock:
             self.enabled = bool(config.get(WatchdogOptions.ENABLED))
@@ -149,6 +161,9 @@ class Watchdog:
             for site, attr in self._SITE_OPTIONS.items():
                 self.deadlines[site] = float(
                     config.get(getattr(WatchdogOptions, attr)))
+            for site, attr in self._NET_SITE_OPTIONS.items():
+                self.deadlines[site] = float(
+                    config.get(getattr(NetworkOptions, attr)))
 
     def reset(self) -> None:
         """Back to defaults and clear trip accounting (test isolation)."""
@@ -193,6 +208,17 @@ class Watchdog:
             except Exception:  # noqa: BLE001 - best-effort cleanup hook
                 pass
         raise StallError(site, d, scope)
+
+    def note_stall(self, site: str, deadline: float,
+                   scope: Optional[str] = None) -> StallError:
+        """Record a deadline expiry observed by a caller that runs its
+        own bounded retry loop instead of a supervised worker (the
+        transport's reconnect path owns the socket lifecycle, so it
+        cannot run under ``run``): counts the trip into the same
+        events/metrics surface and returns the typed error for the
+        caller to raise."""
+        self._note_trip(site, scope, deadline)
+        return StallError(site, deadline, scope)
 
     def _note_trip(self, site: str, scope: Optional[str],
                    deadline: float) -> None:

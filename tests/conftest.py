@@ -22,21 +22,33 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _clean_net_events():
+    """The transport-plane event log (merged into REST /exceptions) is
+    process-global; clear it per test so one test's reconnect/sever
+    events don't surface in another's exception-history assertions."""
+    from flink_tpu.cluster.transport import NET_EVENTS
+    NET_EVENTS.clear()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _stall_wall_clock_guard(request):
-    """Hard per-test wall-clock guard for `stall`-marked tests: the stall
-    watchdog's own regressions must FAIL the suite, not hang it. SIGALRM
-    fires in the main thread and unwinds whatever wait the test is
-    blocked in (hang injections use <=50ms delays, so 120s means a real
-    supervision bug, not a slow box)."""
-    if request.node.get_closest_marker("stall") is None:
+    """Hard per-test wall-clock guard for `stall`- and `netfault`-marked
+    tests: the stall watchdog's (or the reconnect path's) own regressions
+    must FAIL the suite, not hang it. SIGALRM fires in the main thread
+    and unwinds whatever wait the test is blocked in (hang injections use
+    <=50ms delays and reconnect deadlines are a few seconds, so 120s
+    means a real supervision bug, not a slow box)."""
+    if (request.node.get_closest_marker("stall") is None
+            and request.node.get_closest_marker("netfault") is None):
         yield
         return
     import signal
 
     def _expired(signum, frame):
         raise TimeoutError(
-            "stall test exceeded its 120s wall-clock guard — the stall "
-            "watchdog failed to bound a hang")
+            "stall/netfault test exceeded its 120s wall-clock guard — "
+            "a hang went unbounded by supervision or reconnect deadlines")
 
     old = signal.signal(signal.SIGALRM, _expired)
     signal.alarm(120)

@@ -481,3 +481,101 @@ def test_tiny_q5_pipeline_exactly_once_under_chaos(seed):
         got[(int(k), int(we))] = (int(cnt), int(total))
     assert got == expect, f"seed {seed}: results diverged under chaos"
     assert DEVICE_STATS.injected_faults > 0
+
+
+# ---------------------------------------------------------------------------
+# network partition drills: severed cross-host edges (PR 6)
+# ---------------------------------------------------------------------------
+
+def _two_host_sever_trial(spec: str, reconnect_timeout: float,
+                          checkpoint_interval: float = 0.0):
+    """Two DistributedHosts in-process with net.* faults armed; returns
+    (sink rows, coordinator) after both run loops exit."""
+    import threading
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.cluster.distributed import DistributedHost
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.config import NetworkOptions, RuntimeOptions
+
+    sinks = [CollectSink(), CollectSink()]
+    graphs = []
+    for h in range(2):
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.config.set(PipelineOptions.BATCH_SIZE, 16)
+        env.config.set(FaultOptions.ENABLED, True)
+        env.config.set(FaultOptions.SEED, 0)
+        env.config.set(FaultOptions.SPEC, spec)
+        env.config.set(NetworkOptions.RECONNECT_TIMEOUT, reconnect_timeout)
+        env.config.set(NetworkOptions.RECONNECT_BACKOFF, 0.01)
+        # small heartbeat -> small restart grace window (the coordinator
+        # waits out hb_timeout before redeploying)
+        env.config.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.05)
+        if checkpoint_interval:
+            env.config.set(CheckpointingOptions.INTERVAL,
+                           checkpoint_interval)
+            env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
+            env.config.set(RuntimeOptions.RESTART_ATTEMPTS, 3)
+            env.config.set(RuntimeOptions.RESTART_DELAY, 0.05)
+        n = 200
+        rows = [(i % 10, i) for i in range(n)]
+        ds = env.from_collection(rows, SCHEMA, timestamps=list(range(n)))
+        ds.key_by("k").sum(1).add_sink(sinks[h], "sink")
+        graphs.append(env.get_job_graph("net-chaos"))
+
+    h0 = DistributedHost(graphs[0], graphs[0].config, 0, 2)
+    h1 = DistributedHost(graphs[1], graphs[1].config, 1, 2,
+                         coordinator_addr=f"127.0.0.1:"
+                         f"{h0.coordinator.port}")
+    peers = {0: h0.data_address, 1: h1.data_address}
+    threads = [threading.Thread(target=h.run, args=(peers,),
+                                kwargs={"timeout": 90}, daemon=True)
+               for h in (h1, h0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(110)
+        assert not t.is_alive(), "host wedged under network chaos"
+    coord = h0.coordinator
+    h0.close()
+    h1.close()
+    return sinks[0].rows + sinks[1].rows, coord
+
+
+@pytest.mark.netfault
+def test_severed_data_channels_heal_without_restart():
+    """The acceptance drill: net.sever kills every cross-host connection
+    repeatedly mid-stream — the channels reconnect and replay under the
+    deadline, results stay exactly-once, network_reconnects_total moves,
+    and the restart counter NEVER does (a healed partition is not a
+    failover)."""
+    r0 = DEVICE_STATS.net_reconnects
+    rows, coord = _two_host_sever_trial("net.sever=every@7",
+                                        reconnect_timeout=10.0)
+    assert coord.restarts == 0, "a healed sever must not restart regions"
+    assert coord.failed is None
+    assert DEVICE_STATS.net_reconnects > r0
+    assert len(rows) == 200
+    finals = {}
+    for k, v in rows:
+        finals[k] = max(finals.get(k, 0), v)
+    assert finals == {k: sum(i for i in range(200) if i % 10 == k)
+                      for k in range(10)}
+
+
+@pytest.mark.netfault
+def test_sever_with_zero_deadline_escalates_to_one_restart():
+    """Forcing net.reconnect-timeout to 0 turns the SAME sever into a
+    StallError that rides the existing ladder: exactly one region
+    restart, and the job still completes exactly-once."""
+    rows, coord = _two_host_sever_trial("net.sever=once@9",
+                                        reconnect_timeout=0.0,
+                                        checkpoint_interval=0.1)
+    assert coord.restarts == 1, "deadline-0 sever must restart exactly once"
+    assert coord.failed is None
+    finals = {}
+    for k, v in rows:
+        finals[k] = max(finals.get(k, 0), v)
+    assert finals == {k: sum(i for i in range(200) if i % 10 == k)
+                      for k in range(10)}
