@@ -314,7 +314,7 @@ def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
 
 def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
                 n_batches: int = 8, metrics_registry=None,
-                chaos_seed=None) -> dict:
+                chaos_seed=None, extra_config: dict = None) -> dict:
     """Tiny Q5 acceptance probe (tier-1 safe, no backend subprocess
     probe): warmup + timed run on whatever backend jax already has;
     returns the timed run's stage report with the embedded metrics
@@ -326,15 +326,17 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
     counters the run produced. The recompile invariant is NOT asserted
     under chaos (retried compiles legitimately recount)."""
     n_events = n_batches * batch
-    extra = None
+    extra = dict(extra_config) if extra_config else None
     if chaos_seed is not None:
-        extra = {"faults.enabled": True, "faults.seed": int(chaos_seed),
+        extra = dict(extra or {})
+        extra.update(
+                {"faults.enabled": True, "faults.seed": int(chaos_seed),
                  "faults.spec": CHAOS_SPEC,
                  # tighten the transfer deadline under the injected d2h
                  # hangs so the chaos run exercises the watchdog
                  # stall->retry path (watchdog_trips_total > 0)
                  "watchdog.transfer-timeout": 0.012,
-                 "state.backend.tpu.host-index": False}
+                 "state.backend.tpu.host-index": False})
         from flink_tpu.runtime.faults import FAULTS
         from flink_tpu.runtime.watchdog import WATCHDOG
         FAULTS.reset()  # arm fresh: visit counters start at zero
@@ -864,6 +866,7 @@ def main(breakdown: bool = False):
               "ms", 1.0)
     _line("nexmark_q5_framework_events_per_sec_1M_keys", eps,
           "events/sec/chip", eps / host_eps)
+    _maybe_write_trace("q5")
     return eps, p99, stages, host_eps
 
 
@@ -972,16 +975,52 @@ def bench_topk_ab() -> None:
                   skipped="pallas needs the real TPU backend")
 
 
+#: Set by ``--trace [PREFIX]``: each stage writes its retained spans to
+#: ``<PREFIX>.<stage>.trace.json`` as Chrome trace-event JSON (load the
+#: file in Perfetto / chrome://tracing).
+TRACE_PREFIX = ""
+
+
+def _trace_extra_config() -> dict:
+    """Under --trace, run with periodic checkpointing on so the trace
+    carries full checkpoint trees alongside device/mailbox spans. The
+    interval must undercut even the tiny stage's sub-second wall clock,
+    or the traced run would end before the first trigger fires."""
+    if not TRACE_PREFIX:
+        return {}
+    return {"execution.checkpointing.interval": 0.05}
+
+
+def write_trace(stage: str, prefix: str = None) -> str:
+    """Export the global tracer's retained spans for one bench stage as
+    Perfetto-loadable trace-event JSON; returns the path written."""
+    from flink_tpu.metrics.tracing import TRACER, chrome_trace_events
+
+    spans = TRACER.retained_spans()
+    path = f"{prefix or TRACE_PREFIX or 'bench'}.{stage}.trace.json"
+    with open(path, "w") as f:
+        json.dump(chrome_trace_events(spans), f)
+    print(json.dumps({"metric": "trace_file", "unit": "path",
+                      "stage": stage, "path": path, "spans": len(spans)}))
+    return path
+
+
+def _maybe_write_trace(stage: str) -> None:
+    if TRACE_PREFIX:
+        write_trace(stage)
+
+
 def tiny() -> None:
     """`python bench.py --tiny`: the acceptance probe — one JSON line,
     the tiny Q5 stage report with the metrics snapshot embedded."""
     probe = _ensure_backend()
     _emit_probe(probe)
-    stages = run_tiny_q5()
+    stages = run_tiny_q5(extra_config=_trace_extra_config())
     rec = {"metric": "nexmark_q5_tiny_stage_report", "unit": "report"}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in stages.items()})
     print(json.dumps(rec))
+    _maybe_write_trace("tiny_q5")
     sys.stdout.flush()
 
 
@@ -993,9 +1032,14 @@ def chaos(seed: int) -> None:
     seed => byte-identical trip schedule."""
     probe = _ensure_backend()
     _emit_probe(probe)
-    stages = run_tiny_q5(chaos_seed=seed)
+    stages = run_tiny_q5(chaos_seed=seed,
+                         extra_config=_trace_extra_config())
+    from flink_tpu.metrics.tracing import FLIGHT_RECORDER
     rec = {"metric": "nexmark_q5_tiny_chaos_report", "unit": "report",
            "chaos_spec": CHAOS_SPEC,
+           # post-mortem surface: flight-recorder dumps the chaos run's
+           # fault chokepoints (stalls, fences, restarts) wrote to disk
+           "flight_dumps": [d["path"] for d in FLIGHT_RECORDER.dumps],
            # verified-recovery surface: restore fallbacks taken and
            # artifact verification failures seen during the chaos run
            "restore_fallbacks": stages.get("restore_fallbacks_total", 0),
@@ -1010,10 +1054,17 @@ def chaos(seed: int) -> None:
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in stages.items()})
     print(json.dumps(rec))
+    _maybe_write_trace("tiny_q5_chaos")
     sys.stdout.flush()
 
 
 if __name__ == "__main__":
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        TRACE_PREFIX = (sys.argv[i + 1]
+                        if (len(sys.argv) > i + 1
+                            and not sys.argv[i + 1].startswith("--"))
+                        else "bench")
     if "--probe-timeout" in sys.argv:
         # override bench.probe-timeout for this invocation (the config
         # key applies when a job Configuration reaches the watchdog; the

@@ -82,6 +82,9 @@ class _Pending:
     # completing without them would restore them empty later)
     expected: frozenset = frozenset()
     declined: bool = False
+    # root SpanBuilder of this checkpoint's trace tree; its context rides
+    # the barrier so task-side Align/Snapshot spans become its children
+    span: Any = None
     done = None  # threading.Event set on complete/abort
 
     def __post_init__(self):
@@ -139,10 +142,18 @@ class CheckpointCoordinator:
                 raise RuntimeError("checkpointing paused (region restart)")
             cid = self._next_id
             self._next_id += 1
+            span = None
+            if self.tracer is not None:
+                span = (self.tracer.span("checkpoint", "Checkpoint")
+                        .set_attribute("checkpointId", cid)
+                        .set_attribute("savepoint", is_savepoint))
             pending = _Pending(cid, time.time(), is_savepoint,
-                               expected=frozenset(self.job.tasks))
+                               expected=frozenset(self.job.tasks),
+                               span=span)
             self._pending[cid] = pending
-        barrier = CheckpointBarrier(cid, is_savepoint=is_savepoint)
+        barrier = CheckpointBarrier(
+            cid, is_savepoint=is_savepoint,
+            trace=span.context.to_wire() if span is not None else None)
         for st in self.job.source_tasks.values():
             st.trigger_checkpoint(barrier)
         return pending
@@ -197,6 +208,9 @@ class CheckpointCoordinator:
         if p is not None:
             p.declined = True
             p.done.set()
+            if p.span is not None:
+                p.span.set_attribute("aborted", True).set_attribute(
+                    "declined_by", task_id).finish()
             # tasks that already snapshotted this id hold generation pins
             # (changelog DSTL); a declined checkpoint is abandoned exactly
             # like a timed-out one and must release them
@@ -214,12 +228,21 @@ class CheckpointCoordinator:
             checkpoint_id=p.checkpoint_id, timestamp=p.started,
             task_snapshots=dict(p.acks), is_savepoint=p.is_savepoint,
             vertex_parallelism=vertex_par, vertex_uids=vertex_uids)
+        store_sb = None
+        if p.span is not None:
+            store_sb = (self.tracer.span("checkpoint", "Store",
+                                         parent=p.span.context)
+                        .set_attribute("checkpointId", p.checkpoint_id))
         try:
             cp = self.storage.store(cp)
         except Exception as e:  # noqa: BLE001 - storage outage/injection
             # a failed checkpoint WRITE must not fail the job (reference:
             # tolerable checkpoint failures): abort this checkpoint, keep
             # running on the previous completed one, record the event
+            if store_sb is not None:
+                store_sb.set_attribute("error", True).finish()
+                p.span.set_attribute("error", True).set_attribute(
+                    "aborted", True).finish()
             with self._lock:
                 self.stats.append({
                     "id": p.checkpoint_id, "savepoint": p.is_savepoint,
@@ -230,14 +253,9 @@ class CheckpointCoordinator:
             p.done.set()
             self._notify_aborted(p.checkpoint_id)
             return
+        if store_sb is not None:
+            store_sb.finish()
         duration = time.time() - p.started
-        if self.tracer is not None:
-            (self.tracer.span("checkpoint-coordinator", "Checkpoint")
-             .set_start_ts(int(p.started * 1000))
-             .set_attribute("checkpointId", p.checkpoint_id)
-             .set_attribute("savepoint", p.is_savepoint)
-             .set_attribute("tasks", len(p.acks))
-             .finish(int(time.time() * 1000)))
         with self._lock:
             # keep the store ordered by checkpoint id, not completion time:
             # with max-concurrent > 1 a slow older checkpoint may complete
@@ -255,11 +273,24 @@ class CheckpointCoordinator:
                 self._completed.remove(old)
                 self.storage.discard(old)
         # notify tasks (two-phase-commit sinks commit on this)
+        notify_sb = None
+        if p.span is not None:
+            notify_sb = (self.tracer.span("checkpoint", "Notify",
+                                          parent=p.span.context)
+                         .set_attribute("checkpointId", p.checkpoint_id)
+                         .set_attribute("tasks", len(self.job.tasks)))
         for t in self.job.tasks.values():
             t.execute_in_mailbox(
                 lambda t=t: t.chain.notify_checkpoint_complete(
                     p.checkpoint_id, is_savepoint=p.is_savepoint)
                 if getattr(t, "chain", None) else None)
+        if notify_sb is not None:
+            notify_sb.finish()
+        if p.span is not None:
+            (p.span.set_attribute("tasks", len(p.acks))
+             .set_start_ts(int(p.started * 1000))
+             .set_attribute("duration_s", round(duration, 6))
+             .finish())
         p.completed = cp
         p.done.set()
 
@@ -273,6 +304,8 @@ class CheckpointCoordinator:
             for cid, p in list(self._pending.items()):
                 p.declined = True
                 p.done.set()
+                if p.span is not None:
+                    p.span.set_attribute("aborted", True).finish()
                 del self._pending[cid]
         for cid in aborted:
             self._notify_aborted(cid)
@@ -315,6 +348,22 @@ class CheckpointCoordinator:
 
         verify = self.config.get(CheckpointingOptions.VERIFY_ON_RESTORE)
         quarantine = self.config.get(CheckpointingOptions.QUARANTINE_CORRUPT)
+        restore_sb = (self.tracer.span("restore", "Restore")
+                      if self.tracer is not None else None)
+        try:
+            return self._verified_candidate(
+                verify, quarantine, restore_sb, DEVICE_STATS)
+        except BaseException:
+            if restore_sb is not None:
+                restore_sb.set_attribute("error", True).finish()
+                restore_sb = None
+            raise
+        finally:
+            if restore_sb is not None:
+                restore_sb.finish()
+
+    def _verified_candidate(self, verify, quarantine, restore_sb,
+                            DEVICE_STATS) -> Optional[CompletedCheckpoint]:
         skipped = 0
         while True:
             with self._lock:
@@ -352,12 +401,22 @@ class CheckpointCoordinator:
             break
         if skipped:
             DEVICE_STATS.note_restore_fallback("checkpoint.restore")
+            if restore_sb is not None:
+                (self.tracer.span("restore", "Fallback",
+                                  parent=restore_sb.context)
+                 .set_attribute("checkpointId", cand.checkpoint_id)
+                 .set_attribute("skipped", skipped)
+                 .finish())
             hist = getattr(self.job, "failure_history", None)
             if hist is not None:
                 hist.append({"timestamp": time.time(),
                              "kind": "restore-fallback",
                              "checkpoint": cand.checkpoint_id,
                              "skipped": skipped})
+        if restore_sb is not None:
+            restore_sb.set_attribute(
+                "checkpointId", cand.checkpoint_id).set_attribute(
+                "skipped", skipped)
         return cand
 
     # -- periodic loop -----------------------------------------------------
@@ -381,6 +440,10 @@ class CheckpointCoordinator:
                     if now - p.started > self.timeout:
                         del self._pending[cid]
                         p.done.set()
+                        if p.span is not None:
+                            p.span.set_attribute(
+                                "aborted", True).set_attribute(
+                                "timeout", True).finish()
                         timed_out.append(cid)
                 in_flight = len(self._pending)
                 too_soon = now - self._last_complete_time < self.min_pause

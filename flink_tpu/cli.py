@@ -244,6 +244,46 @@ def _print_table(schema_names, rows, max_rows: int) -> None:
     print(f"{len(rows)} row(s){tail}", flush=True)
 
 
+def _cmd_trace_dump(args) -> int:
+    """Fetch retained spans from a running endpoint's
+    ``/jobs/<name>/traces`` and either write them as Chrome trace-event
+    JSON (``-o`` — load the file in Perfetto / chrome://tracing) or
+    print a span table. Falls back to THIS process's tracer when no
+    ``--target`` is given (useful right after an in-process run)."""
+    import json as _json
+    import urllib.request
+
+    from .metrics.tracing import Span, TRACER, chrome_trace_events
+
+    if args.target:
+        url = f"http://{args.target}/jobs/{args.job}/traces"
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                payload = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"trace-dump: cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+        spans = [Span(scope=d["scope"], name=d["name"],
+                      start_ms=d["start_ms"], end_ms=d["end_ms"],
+                      attributes=d.get("attributes") or {},
+                      trace_id=d.get("trace_id", ""),
+                      span_id=d.get("span_id", ""),
+                      parent_id=d.get("parent_id", ""))
+                 for d in payload.get("spans", [])]
+    else:
+        spans = TRACER.retained_spans()
+    if args.output:
+        with open(args.output, "w") as f:
+            _json.dump(chrome_trace_events(spans), f)
+        print(f"wrote {len(spans)} span(s) to {args.output}")
+        return 0
+    rows = [[s.scope, s.name, s.start_ms, s.duration_ms, s.trace_id,
+             s.parent_id or "-"] for s in spans]
+    _print_table(["scope", "name", "start_ms", "dur_ms", "trace", "parent"],
+                 rows, max_rows=args.max_rows)
+    return 0
+
+
 def _cmd_sql(args) -> int:
     """Interactive SQL client against a TableEnvironment (reference
     flink-table/flink-sql-client SqlClient.java:67): DDL mutates the
@@ -389,6 +429,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     cvf.add_argument("dir", help="checkpoint storage directory "
                                  "(execution.checkpointing.dir)")
     cvf.set_defaults(fn=_cmd_checkpoint_verify)
+
+    trd = sub.add_parser(
+        "trace-dump",
+        help="dump causal-trace spans from a running job (or this "
+             "process) as a table or Perfetto-loadable JSON")
+    trd.add_argument("--target", default="",
+                     help="host:port of a REST endpoint; empty = the "
+                          "current process's tracer")
+    trd.add_argument("--job", default="job",
+                     help="job name on the endpoint (default: job)")
+    trd.add_argument("-o", "--output", default="",
+                     help="write Chrome trace-event JSON here instead of "
+                          "printing a table")
+    trd.add_argument("--max-rows", type=int, default=200)
+    trd.set_defaults(fn=_cmd_trace_dump)
 
     gwp = sub.add_parser("sql-gateway",
                          help="serve the REST SQL gateway")

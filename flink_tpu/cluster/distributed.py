@@ -136,6 +136,10 @@ class _Coordinator:
         self._next_cid = 1
         self._pending_acks: dict[int, dict[str, dict]] = {}
         self._pending_hosts: dict[int, set[int]] = {}
+        # root SpanBuilder per in-flight checkpoint: its context rides the
+        # trigger broadcast so worker-side Align/Snapshot spans join the
+        # coordinator's tree across the transport boundary
+        self._pending_spans: dict[int, Any] = {}
         self.completed: list[CompletedCheckpoint] = []
         self._vertex_parallelism: dict[str, int] = {}
         self._vertex_uids: dict[str, str] = {}
@@ -248,6 +252,11 @@ class _Coordinator:
                     with self._lock:
                         self._pending_acks.pop(msg["checkpoint_id"], None)
                         self._pending_hosts.pop(msg["checkpoint_id"], None)
+                        sp = self._pending_spans.pop(
+                            msg["checkpoint_id"], None)
+                    if sp is not None:
+                        sp.set_attribute("aborted", True).set_attribute(
+                            "declined_by", msg.get("host_id")).finish()
                 elif kind == "finished":
                     with self._lock:
                         # a stale pre-restart completion must not mark the
@@ -306,6 +315,7 @@ class _Coordinator:
         """Returns the checkpoint id, or -1 when not all hosts have
         registered yet (triggering early would complete with a subset of
         the tasks — not a consistent snapshot)."""
+        from ..metrics.tracing import TRACER
         with self._lock:
             if not set(self._workers) >= self._expected:
                 return -1
@@ -313,8 +323,16 @@ class _Coordinator:
             self._next_cid += 1
             self._pending_acks[cid] = {}
             self._pending_hosts[cid] = set(self._workers)
+            span = None
+            if TRACER.enabled:
+                span = (TRACER.span("checkpoint", "Checkpoint")
+                        .set_attribute("checkpointId", cid)
+                        .set_attribute("savepoint", is_savepoint)
+                        .set_attribute("hosts", len(self._pending_hosts[cid])))
+                self._pending_spans[cid] = span
         self.broadcast({"type": "trigger_checkpoint", "checkpoint_id": cid,
-                        "savepoint": is_savepoint})
+                        "savepoint": is_savepoint,
+                        "trace": span.context.to_wire() if span else None})
         return cid
 
     def _canonical_snapshots(self, host_id: int, snapshots: dict) -> dict:
@@ -367,6 +385,13 @@ class _Coordinator:
                     vertex_uids=dict(self._vertex_uids))
                 del self._pending_hosts[cid]
         if complete is not None:
+            from ..metrics.tracing import TRACER
+            with self._lock:
+                root_sb = self._pending_spans.pop(cid, None)
+            store_sb = (TRACER.span("checkpoint", "Store",
+                                    parent=root_sb.context)
+                        .set_attribute("checkpointId", cid)
+                        if root_sb is not None else None)
             try:
                 complete = self.storage.store(complete)
             except Exception as e:  # noqa: BLE001 - storage outage
@@ -378,7 +403,12 @@ class _Coordinator:
                         "timestamp": time.time(), "checkpoint": cid,
                         "kind": "checkpoint-write-failure",
                         "error": f"{type(e).__name__}: {e}"})
+                if store_sb is not None:
+                    store_sb.set_attribute("error", True).finish()
+                    root_sb.set_attribute("error", True).finish()
                 return
+            if store_sb is not None:
+                store_sb.finish()
             with self._lock:
                 if self.epoch != epoch:
                     # a restart was arranged while this checkpoint was in
@@ -390,6 +420,8 @@ class _Coordinator:
                         "timestamp": time.time(), "checkpoint": cid,
                         "kind": "checkpoint-superseded",
                         "epoch": epoch, "current_epoch": self.epoch})
+                    if root_sb is not None:
+                        root_sb.set_attribute("aborted", True).finish()
                     return
                 self.completed.append(complete)
             # stamped with the epoch CAPTURED at ack time (not re-read:
@@ -401,6 +433,12 @@ class _Coordinator:
                             "checkpoint_id": cid,
                             "epoch": epoch,
                             "savepoint": complete.is_savepoint})
+            if root_sb is not None:
+                (TRACER.span("checkpoint", "Notify", parent=root_sb.context)
+                 .set_attribute("checkpointId", cid)
+                 .set_attribute("hosts", self.n_hosts)
+                 .finish())
+                root_sb.finish()
 
     # -- failover ----------------------------------------------------------
     def _verified_candidate_locked(self):
@@ -502,6 +540,9 @@ class _Coordinator:
                 "timestamp": now, "kind": "restart", "epoch": self.epoch,
                 "reason": reason, "live_hosts": list(live)})
             epoch = self.epoch
+            # abandoned checkpoints die with the deposed attempt
+            orphan_spans = list(self._pending_spans.values())
+            self._pending_spans.clear()
             self._expected = set(live)
             self._all_done_sent = False
             self._pending_acks.clear()
@@ -510,6 +551,15 @@ class _Coordinator:
                 w.finished = False
             cp = self._verified_candidate_locked()
             self._restart_inflight = False
+        from ..metrics.tracing import TRACER, dump_flight_recorder
+        for sp in orphan_spans:
+            sp.set_attribute("aborted", True).finish()
+        dump_flight_recorder("job-restart", epoch=epoch, cause=reason,
+                             live_hosts=list(live))
+        restart_sb = (TRACER.span("restart", "JobRestart")
+                      .set_attribute("epoch", epoch)
+                      .set_attribute("reason", reason)
+                      .set_attribute("live_hosts", list(live)))
         if cp is _NO_VERIFIED_CHECKPOINT:
             # checkpoints existed but none verifies: redeploying from
             # scratch would replay the whole stream past committed output
@@ -517,6 +567,7 @@ class _Coordinator:
             self.failed = (f"{reason}; CorruptArtifactError: all retained "
                            "checkpoints failed verification")
             self.broadcast({"type": "cancel"})
+            restart_sb.set_attribute("error", True).finish()
             return
         msg = {"type": "restart", "epoch": epoch, "live_hosts": live,
                "slots": self.resources.slots_map(live),
@@ -527,6 +578,10 @@ class _Coordinator:
             else:
                 msg["checkpoint"] = cp  # in-memory storage: ship it inline
         self.broadcast(msg)
+        (restart_sb
+         .set_attribute("restored",
+                        cp.checkpoint_id if cp is not None else None)
+         .finish())
 
     # -- liveness ----------------------------------------------------------
     def monitor(self, heartbeat_timeout: float) -> None:
@@ -662,6 +717,11 @@ class DistributedHost:
         from ..runtime.watchdog import WATCHDOG
         FAULTS.configure(config)
         WATCHDOG.configure(config)
+        # same on-by-default tracing wiring as the local deploy path
+        from ..metrics.device import set_compile_tracer
+        from ..metrics.tracing import TRACER
+        TRACER.configure(config)
+        set_compile_tracer(TRACER if TRACER.enabled else None)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "
@@ -1048,7 +1108,8 @@ class DistributedHost:
             self._pending_ckpts[cid] = (len(self.job.tasks),
                                         msg["savepoint"])
             barrier = CheckpointBarrier(
-                cid, is_savepoint=msg["savepoint"])
+                cid, is_savepoint=msg["savepoint"],
+                trace=msg.get("trace"))
             for t in self.job.source_tasks.values():
                 t.trigger_checkpoint(barrier)
         elif msg["type"] == "checkpoint_complete":

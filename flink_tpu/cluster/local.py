@@ -177,6 +177,13 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     from ..runtime.watchdog import WATCHDOG
     FAULTS.configure(config)
     WATCHDOG.configure(config)
+    # job-wide causal tracing is on by default: the global tracer picks up
+    # traces.* limits from this job's config and the compile cache reports
+    # device spans into the same trace trees
+    from ..metrics.device import set_compile_tracer
+    from ..metrics.tracing import TRACER
+    TRACER.configure(config)
+    set_compile_tracer(TRACER if TRACER.enabled else None)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape
@@ -226,6 +233,13 @@ def restart_region(job: "LocalJob", job_graph: JobGraph,
     running untouched. Returns the restarted task ids."""
     affected = [tid for tid in list(job.tasks)
                 if tid.rsplit("#", 1)[0] in vids]
+    from ..metrics.tracing import TRACER, dump_flight_recorder
+    restart_sb = (TRACER.span("restart", "RegionRestart")
+                  .set_attribute("job", job_graph.name)
+                  .set_attribute("vertices", sorted(vids))
+                  .set_attribute("tasks", len(affected)))
+    dump_flight_recorder("region-restart", job=job_graph.name,
+                         vertices=sorted(vids), tasks=affected)
     old = []
     for tid in affected:
         t = job.tasks.pop(tid)
@@ -269,6 +283,7 @@ def restart_region(job: "LocalJob", job_graph: JobGraph,
         job.tasks[tid].start()
         with job._lock:
             job._exec_set(tid, "RUNNING")
+    restart_sb.finish()
     return affected
 
 
@@ -425,7 +440,9 @@ def run_job(job_graph: JobGraph, config: Configuration,
     interval = config.get(CheckpointingOptions.INTERVAL)
     if interval and interval > 0:
         from ..checkpoint.coordinator import CheckpointCoordinator
-        coordinator = CheckpointCoordinator(job, config)
+        from ..metrics.tracing import TRACER
+        coordinator = CheckpointCoordinator(
+            job, config, tracer=TRACER if TRACER.enabled else None)
         coordinator.start_periodic()
     job.coordinator = coordinator
     # task-progress supervision: without a supervisor there is no restart

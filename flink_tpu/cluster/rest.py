@@ -14,6 +14,10 @@ reduced to the operationally useful slice:
                                      checkpoint writes — the reference's
                                      JobExceptionsHandler analog)
     GET  /jobs/<name>/flamegraph  -> sampled task-thread flamegraph trie
+    GET  /jobs/<name>/traces      -> retained completed spans (causal
+                                     tracing; metrics/tracing.py)
+    GET  /jobs/<name>/flight-recorder -> flight-recorder dump records +
+                                     the live ring's tail (post-mortems)
     POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
     GET  /metrics                 -> prometheus text exposition (always
                                      includes the device-path scope:
@@ -133,6 +137,29 @@ class RestEndpoint:
         from .webui import sample_flamegraph
         return sample_flamegraph(job, duration_s=1.0)
 
+    def _traces(self, name: str) -> Optional[dict]:
+        """Retained completed spans from the process-global tracer —
+        checkpoint trees, device steps, net/restart episodes. The
+        ``chrome=1`` rendering (trace-event JSON) happens client-side in
+        the CLI; this endpoint ships raw span dicts."""
+        if name not in self._jobs:
+            return None
+        from ..metrics.tracing import TRACER
+        return {"name": name,
+                "spans": [s.to_dict() for s in TRACER.retained_spans()]}
+
+    def _flight_recorder(self, name: str) -> Optional[dict]:
+        """Post-mortem surface: the dump records written so far (stalls,
+        restarts, corrupt artifacts, zombie fences) plus the live ring's
+        tail, so an operator can fetch the black box without shelling
+        into the host."""
+        if name not in self._jobs:
+            return None
+        from ..metrics.tracing import FLIGHT_RECORDER
+        return {"name": name,
+                "dumps": list(FLIGHT_RECORDER.dumps),
+                "recent": FLIGHT_RECORDER.snapshot()[-64:]}
+
     def _metrics_registry(self):
         """The bound registry, or a lazily-created one carrying only the
         process-global device scope — /metrics must expose compile and
@@ -217,6 +244,16 @@ class RestEndpoint:
                     exc = endpoint._exceptions(parts[1])
                     self._reply(200 if exc else 404,
                                 exc or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "traces"):
+                    tr = endpoint._traces(parts[1])
+                    self._reply(200 if tr else 404,
+                                tr or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "flight-recorder"):
+                    fr = endpoint._flight_recorder(parts[1])
+                    self._reply(200 if fr else 404,
+                                fr or {"error": "no such job"})
                 elif parts == ["metrics", "snapshot"]:
                     self._reply(200, endpoint._metrics_snapshot())
                 elif parts == ["metrics"]:

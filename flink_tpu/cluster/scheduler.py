@@ -64,7 +64,9 @@ class JobSupervisor:
                            restored_state=restored_state,
                            metrics_registry=self.metrics_registry)
         job.failure_history = self.failure_history  # survives redeploys
-        coordinator = CheckpointCoordinator(job, self.config)
+        from ..metrics.tracing import TRACER
+        coordinator = CheckpointCoordinator(
+            job, self.config, tracer=TRACER if TRACER.enabled else None)
         if self._latest is not None:
             # keep checkpoint ids monotonically increasing across restarts
             coordinator._next_id = self._latest.checkpoint_id + 1
@@ -164,9 +166,20 @@ class JobSupervisor:
                     "kind": "restart", "error": str(e),
                     "restored_checkpoint": (self._latest.checkpoint_id
                                             if self._latest else None)})
+                from ..metrics.tracing import TRACER, dump_flight_recorder
+                dump_flight_recorder(
+                    "job-restart", job=self.job_graph.name,
+                    attempt=self.attempt, error=str(e))
+                restart_sb = (TRACER.span("restart", "JobRestart")
+                              .set_attribute("job", self.job_graph.name)
+                              .set_attribute("attempt", self.attempt)
+                              .set_attribute("restored",
+                                             self._latest.checkpoint_id
+                                             if self._latest else None))
                 job.cancel()
                 time.sleep(self.restart_strategy.backoff_seconds())
                 restore = self._latest
+                restart_sb.finish()
 
     def _try_region_restart(self, job: LocalJob) -> bool:
         """Pipelined-region failover (reference

@@ -186,9 +186,11 @@ class RemoteChannelSender(Channel):
         deadline disables reconnection of an ESTABLISHED connection
         (fail fast into the ladder) but still allows the initial
         connect its one attempt."""
+        from ..metrics.tracing import TRACER, now_ms
         from ..runtime.faults import FAULTS, InjectedFault
         from ..runtime.watchdog import WATCHDOG
 
+        reconnect_start = now_ms()
         with self._conn_lock:
             with self._io_lock:
                 if self._gen > observed_gen and not self._conn_dead:
@@ -238,6 +240,12 @@ class RemoteChannelSender(Channel):
                 DEVICE_STATS.note_net_reconnect("data")
                 _note_net_event("network-reconnect", channel=self._key,
                                 attempts=attempts, replayed=len(replay))
+                (TRACER.span("net", "Reconnect")
+                 .set_attribute("channel", self._key)
+                 .set_attribute("attempts", attempts)
+                 .set_attribute("replayed", len(replay))
+                 .set_start_ts(reconnect_start)
+                 .finish())
             threading.Thread(target=self._receive_loop, args=(sock, gen),
                              name=f"credits-{self._key}",
                              daemon=True).start()
@@ -512,6 +520,12 @@ class TransportServer:
                 DEVICE_STATS.note_zombie_fenced("transport")
                 _note_net_event("zombie-fenced", channel=key,
                                 peer_epoch=peer_epoch, epoch=epoch)
+                from ..metrics.tracing import TRACER
+                (TRACER.span("net", "Fence")
+                 .set_attribute("channel", key)
+                 .set_attribute("peer_epoch", peer_epoch)
+                 .set_attribute("epoch", epoch)
+                 .finish())
                 try:
                     reply(_TYPE_FENCED, _SEQ.pack(epoch))
                 except OSError:

@@ -61,6 +61,11 @@ class CheckpointBarrier:
     # options mirror CheckpointOptions: savepoint flag + unaligned capability
     is_savepoint: bool = False
     unaligned: bool = False
+    # wire form of the coordinator's TraceContext (metrics/tracing.py):
+    # tasks parent their Align/Snapshot spans on it, so one checkpoint's
+    # spans form a single tree across hosts (barriers are pickled whole
+    # by the transport, so this crosses process boundaries for free).
+    trace: Optional[dict] = None
 
 
 @dataclass(frozen=True)

@@ -19,7 +19,9 @@ from .reporters import (
     register_reporter, reporters_from_config,
 )
 from .tracing import (
-    InMemoryTraceReporter, Span, SpanBuilder, TraceReporter, Tracer,
+    FLIGHT_RECORDER, TRACER, FlightRecorder, InMemoryTraceReporter, Span,
+    SpanBuilder, TraceContext, TraceReporter, Tracer, chrome_trace_events,
+    current_context, dump_flight_recorder, record_flight_event, use_context,
 )
 
 __all__ = [
@@ -28,7 +30,9 @@ __all__ = [
     "MetricRegistry", "TaskMetrics",
     # tracing
     "Span", "SpanBuilder", "TraceReporter", "InMemoryTraceReporter",
-    "Tracer",
+    "Tracer", "TraceContext", "TRACER", "FlightRecorder",
+    "FLIGHT_RECORDER", "chrome_trace_events", "current_context",
+    "use_context", "record_flight_event", "dump_flight_recorder",
     # reporters
     "MetricReporter", "PrometheusReporter", "LoggingReporter",
     "prometheus_text", "register_reporter", "reporters_from_config",

@@ -65,7 +65,10 @@ class DeviceStats:
         self._frames_deduped: dict[str, int] = {}
         self._zombies_fenced: dict[str, int] = {}
         self._net_errors: dict[str, int] = {}
-        self._tracer = None  # optional Tracer receiving Compile spans
+        # tracing accounting (PR 7): spans evicted from the bounded
+        # in-memory trace reporter (traces.max-retained)
+        self._spans_dropped = 0
+        self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
     def note_build(self, scope: str) -> None:
@@ -89,17 +92,37 @@ class DeviceStats:
             sb.finish()
 
     # -- transfer accounting -----------------------------------------------
-    def note_h2d(self, nbytes: int, records: int = 0) -> None:
+    def note_h2d(self, nbytes: int, records: int = 0,
+                 ms: Optional[float] = None) -> None:
         with self._lock:
             self.h2d_bytes += int(nbytes)
             self.h2d_records += int(records)
             self.h2d_batches += 1
+            tracer = self._tracer
+        if tracer is not None:
+            self._finish_transfer(tracer.span("device", "H2D"),
+                                  nbytes, records, ms)
 
-    def note_d2h(self, nbytes: int, records: int = 0) -> None:
+    def note_d2h(self, nbytes: int, records: int = 0,
+                 ms: Optional[float] = None) -> None:
         with self._lock:
             self.d2h_bytes += int(nbytes)
             self.d2h_records += int(records)
             self.d2h_fires += 1
+            tracer = self._tracer
+        if tracer is not None:
+            self._finish_transfer(tracer.span("device", "D2H"),
+                                  nbytes, records, ms)
+
+    @staticmethod
+    def _finish_transfer(sb, nbytes: int, records: int,
+                         ms: Optional[float]) -> None:
+        from .tracing import now_ms
+        end = now_ms()
+        sb.set_attribute("bytes", int(nbytes))
+        sb.set_attribute("records", int(records))
+        sb.set_start_ts(end - int(ms) if ms else end)
+        sb.finish(end)
 
     # -- robustness accounting ---------------------------------------------
     def note_retry(self, scope: str, n: int = 1) -> None:
@@ -132,6 +155,8 @@ class DeviceStats:
         with self._lock:
             self._verify_failures[scope] = \
                 self._verify_failures.get(scope, 0) + 1
+        from .tracing import dump_flight_recorder
+        dump_flight_recorder("corrupt-artifact", scope=scope)
 
     def note_restore_fallback(self, scope: str) -> None:
         with self._lock:
@@ -153,11 +178,23 @@ class DeviceStats:
         with self._lock:
             self._zombies_fenced[scope] = \
                 self._zombies_fenced.get(scope, 0) + 1
+        from .tracing import dump_flight_recorder
+        dump_flight_recorder("zombie-fenced", scope=scope)
 
     def note_net_error(self, direction: str) -> None:
         with self._lock:
             self._net_errors[direction] = \
                 self._net_errors.get(direction, 0) + 1
+
+    # -- tracing accounting --------------------------------------------------
+    def note_spans_dropped(self, n: int = 1) -> None:
+        with self._lock:
+            self._spans_dropped += int(n)
+
+    @property
+    def spans_dropped(self) -> int:
+        with self._lock:
+            return self._spans_dropped
 
     @property
     def net_reconnects(self) -> int:
@@ -262,6 +299,7 @@ class DeviceStats:
                 "zombies_fenced_total":
                     sum(self._zombies_fenced.values()),
                 "network_errors_total": sum(self._net_errors.values()),
+                "spans_dropped_total": self._spans_dropped,
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -307,6 +345,7 @@ class DeviceStats:
             self._frames_deduped.clear()
             self._zombies_fenced.clear()
             self._net_errors.clear()
+            self._spans_dropped = 0
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -438,3 +477,5 @@ def bind_device_metrics(registry) -> None:
     g.gauge("frames_deduped_total", lambda: s.frames_deduped)
     g.gauge("zombies_fenced_total", lambda: s.zombies_fenced)
     g.gauge("network_errors_total", lambda: s.net_errors)
+    # tracing (prometheus: flink_tpu_device_spans_dropped_total)
+    g.gauge("spans_dropped_total", lambda: s.spans_dropped)

@@ -1,21 +1,95 @@
-"""Tracing: spans for checkpoint/recovery/job phases.
+"""Job-wide causal tracing + failure flight recorder.
 
-Analog of the reference's 1.19 trace API (flink-metrics-core
+Analog of the reference trace API (flink-metrics-core
 traces/{Span.java, SpanBuilder.java:27, reporter/TraceReporter.java:31},
 wired by TraceReporterSetup.java:63; checkpoint/recovery durations emitted
-from CheckpointStatsTracker.java:267). Spans are scoped named durations with
-attributes; reporters receive completed spans.
+from CheckpointStatsTracker.java:267), grown into a causal tracing
+subsystem: every span carries ``trace_id``/``span_id``/``parent_id`` so
+related work — a checkpoint's trigger → per-subtask barrier alignment →
+snapshot → artifact store → ack → complete fan-out — forms one tree even
+when the pieces run on different hosts. A :class:`TraceContext` is the
+wire-portable (trace_id, span_id) pair; it crosses process boundaries on
+``CheckpointBarrier.trace`` and the distributed control messages, and
+crosses thread boundaries via an explicit ``parent=`` argument or the
+thread-local ambient context pushed by ``with tracer.span(...)``.
+
+Clocks: span timestamps are *reported* as epoch milliseconds (the
+reference Span contract) but *measured* on the monotonic clock — the
+epoch offset is sampled once at import and added to ``time.monotonic()``
+— so a wall-clock step (NTP slew, manual date change) can never produce
+a negative ``duration_ms``.
+
+Reporters are pluggable (:class:`TraceReporter`): a bounded in-memory
+ring for REST/CLI inspection, a Chrome trace-event (Perfetto-loadable)
+exporter (:func:`chrome_trace_events`), and the always-on
+:class:`FlightRecorder` — a process-global bounded ring of recent
+spans/events dumped to a timestamped JSON file whenever a fault
+chokepoint fires (StallError, region restart, CorruptArtifactError,
+zombie fence), turning every fault-injection drill into a readable
+post-mortem.
+
+The process-global :data:`TRACER` follows the same singleton +
+``configure(config)`` pattern as ``FAULTS``/``WATCHDOG`` and is wired on
+by every deploy path (local ``run_job``, ``JobSupervisor``, distributed
+coordinator/worker).
 """
 
 from __future__ import annotations
 
+import json
+import os
+import re
+import tempfile
 import threading
 import time
+import uuid
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import Any, Dict, Iterable, List, Optional
 
-__all__ = ["Span", "SpanBuilder", "TraceReporter", "InMemoryTraceReporter",
-           "Tracer"]
+__all__ = [
+    "Span", "SpanBuilder", "TraceContext", "TraceReporter",
+    "InMemoryTraceReporter", "FlightRecorder", "Tracer",
+    "TRACER", "FLIGHT_RECORDER", "chrome_trace_events",
+    "current_context", "use_context", "now_ms",
+    "record_flight_event", "dump_flight_recorder", "SPAN_INVENTORY",
+]
+
+# Epoch offset sampled once at import: now_ms() is monotonic-derived but
+# reports epoch milliseconds, so durations are immune to wall-clock steps
+# while start times still line up with log timestamps.
+_EPOCH_OFFSET_MS = time.time() * 1000.0 - time.monotonic() * 1000.0
+
+
+def now_ms() -> int:
+    """Epoch milliseconds measured on the monotonic clock."""
+    return int(time.monotonic() * 1000.0 + _EPOCH_OFFSET_MS)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Wire-portable causal context: the (trace_id, span_id) a child span
+    parents itself on. ``to_wire()`` produces a plain dict safe to embed
+    in pickled control messages and ``CheckpointBarrier.trace``."""
+
+    trace_id: str
+    span_id: str
+
+    def to_wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @staticmethod
+    def from_wire(d: Optional[dict]) -> Optional["TraceContext"]:
+        if not d:
+            return None
+        try:
+            return TraceContext(str(d["trace_id"]), str(d["span_id"]))
+        except Exception:
+            return None
 
 
 @dataclass(frozen=True)
@@ -25,21 +99,90 @@ class Span:
     start_ms: int
     end_ms: int
     attributes: dict = field(default_factory=dict)
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
 
     @property
     def duration_ms(self) -> int:
         return self.end_ms - self.start_ms
 
+    def to_dict(self) -> dict:
+        return {
+            "scope": self.scope, "name": self.name,
+            "start_ms": self.start_ms, "end_ms": self.end_ms,
+            "duration_ms": self.duration_ms,
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "attributes": dict(self.attributes),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Ambient context: a thread-local stack so nested ``with tracer.span(...)``
+# blocks parent automatically without threading a context argument through
+# every call. Cross-thread/cross-host propagation stays explicit (parent=).
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+class use_context:
+    """Pin ``ctx`` as the ambient parent for spans started on this thread
+    inside the block (mailbox threads adopt the coordinator's checkpoint
+    context carried on a barrier this way)."""
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self) -> Optional[TraceContext]:
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        stack.append(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _TLS.stack.pop()
+
 
 class SpanBuilder:
-    """Fluent builder (reference SpanBuilder)."""
+    """Fluent builder (reference SpanBuilder). Usable imperatively
+    (``b = tracer.span(...); ...; b.finish()``) or as a context manager —
+    entering resets the start timestamp and pushes this span's context as
+    the ambient parent for children started inside the block."""
 
-    def __init__(self, tracer: "Tracer", scope: str, name: str):
+    def __init__(self, tracer: "Tracer", scope: str, name: str,
+                 parent: Optional[TraceContext] = None):
         self._tracer = tracer
         self._scope = scope
         self._name = name
-        self._start_ms = int(time.time() * 1000)
+        self._start_ms = now_ms()
         self._attrs: dict = {}
+        if parent is None:
+            parent = current_context()
+        self._trace_id = parent.trace_id if parent else _new_id()
+        self._span_id = _new_id()
+        self._parent_id = parent.span_id if parent else ""
+        self._finished = False
+        self._ctx_cm: Optional[use_context] = None
+
+    @property
+    def context(self) -> TraceContext:
+        """This span's identity, for parenting children (possibly on
+        another host) before the span itself finishes."""
+        return TraceContext(self._trace_id, self._span_id)
+
+    def set_parent(self, ctx: Optional[TraceContext]) -> "SpanBuilder":
+        if ctx is not None:
+            self._trace_id = ctx.trace_id
+            self._parent_id = ctx.span_id
+        return self
 
     def set_attribute(self, key: str, value: Any) -> "SpanBuilder":
         self._attrs[key] = value
@@ -50,17 +193,27 @@ class SpanBuilder:
         return self
 
     def finish(self, end_ms: Optional[int] = None) -> Span:
-        span = Span(self._scope, self._name, self._start_ms,
-                    int(time.time() * 1000) if end_ms is None else end_ms,
-                    dict(self._attrs))
-        self._tracer._report(span)
+        end = now_ms() if end_ms is None else int(end_ms)
+        if end < self._start_ms:        # wall-clock step / caller skew
+            end = self._start_ms
+        span = Span(self._scope, self._name, self._start_ms, end,
+                    dict(self._attrs), self._trace_id, self._span_id,
+                    self._parent_id)
+        if not self._finished:
+            self._finished = True
+            self._tracer._report(span)
         return span
 
     def __enter__(self) -> "SpanBuilder":
-        self._start_ms = int(time.time() * 1000)
+        self._start_ms = now_ms()
+        self._ctx_cm = use_context(self.context)
+        self._ctx_cm.__enter__()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        if self._ctx_cm is not None:
+            self._ctx_cm.__exit__(exc_type, exc, tb)
+            self._ctx_cm = None
         self.set_attribute("error", exc_type is not None)
         self.finish()
 
@@ -73,17 +226,131 @@ class TraceReporter:
 
 
 class InMemoryTraceReporter(TraceReporter):
-    def __init__(self):
+    """Bounded in-memory span ring for tests, REST and the CLI. Retains
+    the most recent ``max_retained`` spans (``traces.max-retained``);
+    evictions are counted into DEVICE_STATS as ``spans_dropped_total``."""
+
+    def __init__(self, max_retained: int = 4096):
         self.spans: list[Span] = []
+        self.max_retained = int(max_retained)
+        self.dropped = 0
         self._lock = threading.Lock()
 
     def add_span(self, span: Span) -> None:
+        excess = 0
         with self._lock:
             self.spans.append(span)
+            if len(self.spans) > self.max_retained:
+                excess = len(self.spans) - self.max_retained
+                del self.spans[:excess]
+                self.dropped += excess
+        if excess:
+            _note_spans_dropped(excess)
 
     def by_name(self, name: str) -> list[Span]:
         with self._lock:
             return [s for s in self.spans if s.name == name]
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self.spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.dropped = 0
+
+
+def _note_spans_dropped(n: int) -> None:
+    try:
+        from .device import DEVICE_STATS
+        DEVICE_STATS.note_spans_dropped(n)
+    except Exception:  # noqa: BLE001 - metrics must not kill reporting
+        pass
+
+
+class FlightRecorder(TraceReporter):
+    """Always-on, low-overhead post-mortem buffer: a bounded ring of the
+    most recent spans and discrete events. ``dump(reason)`` writes the
+    ring to a timestamped JSON file (rate-limited per reason) and is
+    invoked automatically from the fault chokepoints — watchdog stall,
+    region/job restart, corrupt-artifact detection, zombie fence — so
+    the seconds *before* a failure are preserved, not just counters."""
+
+    KEEP_DUMPS = 16
+
+    def __init__(self, capacity: int = 512, dump_dir: Optional[str] = None,
+                 min_dump_interval_s: float = 1.0):
+        self.dump_dir = dump_dir
+        self.min_dump_interval_s = min_dump_interval_s
+        self.dumps: list[dict] = []
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._last_dump_ms: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(1, int(capacity)))
+
+    def add_span(self, span: Span) -> None:
+        entry = {"type": "span", "ts_ms": span.end_ms}
+        entry.update(span.to_dict())
+        with self._lock:
+            self._ring.append(entry)
+
+    def record_event(self, kind: str, **fields: Any) -> None:
+        entry = {"type": "event", "kind": kind, "ts_ms": now_ms()}
+        entry.update(fields)
+        with self._lock:
+            self._ring.append(entry)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str, **fields: Any) -> Optional[str]:
+        """Write the current ring to a timestamped file; returns the path,
+        or None when rate-limited (same reason within
+        ``min_dump_interval_s``) or the write fails."""
+        ts = now_ms()
+        with self._lock:
+            last = self._last_dump_ms.get(reason, 0)
+            if ts - last < self.min_dump_interval_s * 1000.0:
+                return None
+            self._last_dump_ms[reason] = ts
+            entries = list(self._ring)
+        directory = self.dump_dir or os.path.join(
+            tempfile.gettempdir(), "flink_tpu_flight")
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", reason) or "fault"
+        path = os.path.join(directory, f"flight-{safe}-{ts}.json")
+        payload = {"reason": reason, "dumped_at_ms": ts,
+                   "pid": os.getpid(), "entry_count": len(entries),
+                   "context": dict(fields), "entries": entries}
+        try:
+            os.makedirs(directory, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        record = {"reason": reason, "path": path, "ts_ms": ts,
+                  "entry_count": len(entries)}
+        record.update(fields)
+        with self._lock:
+            self.dumps.append(record)
+            del self.dumps[:-self.KEEP_DUMPS]
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dumps.clear()
+            self._last_dump_ms.clear()
 
 
 class Tracer:
@@ -91,16 +358,166 @@ class Tracer:
 
     def __init__(self, reporters: Optional[list[TraceReporter]] = None):
         self._reporters = list(reporters or [])
+        self.enabled = True
 
     def add_reporter(self, reporter: TraceReporter) -> None:
         self._reporters.append(reporter)
 
-    def span(self, scope: str, name: str) -> SpanBuilder:
-        return SpanBuilder(self, scope, name)
+    def span(self, scope: str, name: str,
+             parent: Optional[TraceContext] = None) -> SpanBuilder:
+        return SpanBuilder(self, scope, name, parent=parent)
 
     def _report(self, span: Span) -> None:
+        if not self.enabled:
+            return
         for r in self._reporters:
             try:
                 r.add_span(span)
             except Exception:  # noqa: BLE001 - reporters must not kill jobs
                 pass
+
+    def retained_spans(self) -> list[Span]:
+        """Spans held by the first attached in-memory reporter (the REST
+        / CLI inspection surface)."""
+        for r in self._reporters:
+            if isinstance(r, InMemoryTraceReporter):
+                return r.snapshot()
+        return []
+
+    def configure(self, config) -> None:
+        """Apply ``traces.*`` options (same pattern as FAULTS/WATCHDOG)."""
+        from ..core.config import TraceOptions
+        self.enabled = bool(config.get(TraceOptions.ENABLED))
+        for r in self._reporters:
+            if isinstance(r, InMemoryTraceReporter):
+                r.max_retained = int(config.get(TraceOptions.MAX_RETAINED))
+            elif isinstance(r, FlightRecorder):
+                cap = int(config.get(TraceOptions.FLIGHT_CAPACITY))
+                if cap != r.capacity:
+                    r.set_capacity(cap)
+                r.dump_dir = config.get(TraceOptions.FLIGHT_DIR) or None
+                r.min_dump_interval_s = float(
+                    config.get(TraceOptions.FLIGHT_MIN_INTERVAL))
+
+    def reset(self) -> None:
+        """Test hook: clear retained spans and any attached recorder."""
+        self.enabled = True
+        for r in self._reporters:
+            if isinstance(r, InMemoryTraceReporter):
+                r.clear()
+            elif isinstance(r, FlightRecorder):
+                r.reset()
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event (Perfetto-loadable) export
+# ---------------------------------------------------------------------------
+
+def chrome_trace_events(spans: Iterable[Span], pid: int = 0) -> dict:
+    """Render spans as a Chrome trace-event JSON object (the ``ph: "X"``
+    complete-event form) loadable in Perfetto / chrome://tracing. Scopes
+    map to tids so each subsystem gets its own track; causal ids ride in
+    ``args`` for tree reconstruction."""
+    tids: Dict[str, int] = {}
+    events: List[dict] = []
+    for span in spans:
+        tid = tids.setdefault(span.scope, len(tids))
+        args: Dict[str, Any] = {
+            "trace_id": span.trace_id, "span_id": span.span_id,
+        }
+        if span.parent_id:
+            args["parent_id"] = span.parent_id
+        for k, v in span.attributes.items():
+            args[k] = v if isinstance(v, (int, float, bool, str)) else str(v)
+        events.append({
+            "name": span.name, "cat": span.scope, "ph": "X",
+            "ts": span.start_ms * 1000,
+            "dur": max(span.duration_ms, 0) * 1000,
+            "pid": pid, "tid": tid, "args": args,
+        })
+    for scope, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": scope}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Process-global tracer + flight recorder (singleton pattern of FAULTS /
+# WATCHDOG / DEVICE_STATS; configured by every deploy path).
+# ---------------------------------------------------------------------------
+
+FLIGHT_RECORDER = FlightRecorder()
+
+TRACER = Tracer()
+TRACER.add_reporter(InMemoryTraceReporter())
+TRACER.add_reporter(FLIGHT_RECORDER)
+
+
+def record_flight_event(kind: str, **fields: Any) -> None:
+    """Append a discrete (non-span) event to the flight-recorder ring."""
+    try:
+        FLIGHT_RECORDER.record_event(kind, **fields)
+    except Exception:  # noqa: BLE001 - observability must not kill jobs
+        pass
+
+
+def dump_flight_recorder(reason: str, **fields: Any) -> Optional[str]:
+    """Record ``reason`` as an event, then dump the ring to a file.
+    Called from the fault chokepoints; never raises."""
+    try:
+        FLIGHT_RECORDER.record_event(reason, **fields)
+        return FLIGHT_RECORDER.dump(reason, **fields)
+    except Exception:  # noqa: BLE001 - observability must not kill jobs
+        return None
+
+
+# Every (scope, name) pair the runtime emits, with its emitting site.
+# docs/OBSERVABILITY.md renders this inventory as a table and
+# tests/test_tracing.py asserts the two stay identical, so the doc
+# cannot rot. Keep entries sorted by (scope, name).
+SPAN_INVENTORY: tuple = (
+    ("checkpoint", "Align",
+     "runtime/stream_task.py — barrier arrival → alignment per subtask"),
+    ("checkpoint", "Checkpoint",
+     "checkpoint/coordinator.py + cluster/distributed.py — root span, "
+     "trigger → complete"),
+    ("checkpoint", "Notify",
+     "checkpoint/coordinator.py + cluster/distributed.py — completion "
+     "fan-out to tasks"),
+    ("checkpoint", "Snapshot",
+     "runtime/stream_task.py — per-subtask barrier broadcast + state "
+     "snapshot + ack"),
+    ("checkpoint", "Store",
+     "checkpoint/coordinator.py + cluster/distributed.py — artifact "
+     "store of the completed checkpoint"),
+    ("device", "Compile",
+     "metrics/device.py instrumented_program_cache — XLA compile of a "
+     "device segment"),
+    ("device", "D2H",
+     "metrics/device.py note_d2h — device→host transfer"),
+    ("device", "Execute",
+     "runtime/faults.py DeviceGuard.run — guarded device dispatch "
+     "(retries/degrade included)"),
+    ("device", "H2D",
+     "metrics/device.py note_h2d — host→device transfer"),
+    ("net", "Fence",
+     "cluster/transport.py — zombie producer fenced by epoch check"),
+    ("net", "Reconnect",
+     "cluster/transport.py — severed data channel redial + replay"),
+    ("restart", "JobRestart",
+     "cluster/scheduler.py + cluster/distributed.py _do_restart — "
+     "full-job restart from last verified checkpoint"),
+    ("restart", "RegionRestart",
+     "cluster/local.py restart_region — failover-region restart"),
+    ("restore", "Fallback",
+     "checkpoint/coordinator.py — corrupt candidate skipped, older "
+     "checkpoint selected"),
+    ("restore", "Restore",
+     "checkpoint/coordinator.py latest_verified_checkpoint — verified "
+     "restore-candidate selection"),
+    ("task", "SourceBatch",
+     "runtime/stream_task.py — one source read→emit mailbox cycle"),
+    ("watchdog", "Stall",
+     "runtime/watchdog.py _note_trip — deadline expiry at a guarded "
+     "site"),
+)

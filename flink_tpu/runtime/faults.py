@@ -443,6 +443,7 @@ class DeviceGuard:
         as repeated failures (evacuate + CPU-fallback pin)."""
         if not self.active:
             return fn()
+        from ..metrics.tracing import TRACER
         from .watchdog import WATCHDOG, StallError
 
         def attempt_call():
@@ -452,8 +453,11 @@ class DeviceGuard:
         attempt = 0
         while True:
             try:
-                out = WATCHDOG.run("device.execute", attempt_call,
-                                   scope=self.scope)
+                with (TRACER.span("device", "Execute")
+                      .set_attribute("scope", self.scope)
+                      .set_attribute("attempt", attempt)):
+                    out = WATCHDOG.run("device.execute", attempt_call,
+                                       scope=self.scope)
                 if attempt:
                     self._strategy.notify_recovered()
                 return out

@@ -33,6 +33,7 @@ from ..core.elements import (
 from ..core.records import MIN_TIMESTAMP, RecordBatch
 from ..core.watermarks import WatermarkStrategy
 from ..connectors.core import SinkWriter, Source, SourceReader
+from ..metrics.tracing import TRACER, TraceContext, now_ms
 from ..state.backend import OperatorStateBackend
 from .channels import GateEvent, InputGate
 from .operators.base import OperatorChain, OperatorContext, Output
@@ -147,6 +148,24 @@ class _WriterFanout(Output):
     def emit_side(self, tag: str, batch: RecordBatch) -> None:
         for w in self._side.get(tag, ()):
             w.emit(batch)
+
+
+def _barrier_spans(task_id: str, barrier: CheckpointBarrier,
+                   align: bool = True):
+    """Task-side checkpoint spans, parented on the coordinator context
+    riding the barrier so the whole checkpoint forms one trace tree:
+    emits the Align span (trigger → aligned at this subtask) and returns
+    an open Snapshot builder the caller finishes at ack time."""
+    parent = TraceContext.from_wire(barrier.trace)
+    if align:
+        (TRACER.span("checkpoint", "Align", parent=parent)
+         .set_attribute("task", task_id)
+         .set_attribute("checkpointId", barrier.checkpoint_id)
+         .set_start_ts(int(barrier.timestamp * 1000))
+         .finish())
+    return (TRACER.span("checkpoint", "Snapshot", parent=parent)
+            .set_attribute("task", task_id)
+            .set_attribute("checkpointId", barrier.checkpoint_id))
 
 
 class StreamTask:
@@ -304,6 +323,7 @@ class SourceStreamTask(StreamTask):
             self.chain.initialize_state(snapshot["chain"])
 
     def _snapshot(self, barrier: CheckpointBarrier) -> None:
+        sb = _barrier_spans(self.task_id, barrier, align=False)
         # ① emit barrier downstream first (source is the barrier origin)
         self.broadcast_all(barrier)
         # ② snapshot reader position + chained operators
@@ -312,6 +332,7 @@ class SourceStreamTask(StreamTask):
                           if self.chain else None)}
         self.reporter.acknowledge_checkpoint(
             self.task_id, barrier.checkpoint_id, snap)
+        sb.finish()
 
     def trigger_checkpoint(self, barrier: CheckpointBarrier) -> None:
         self.execute_in_mailbox(lambda: self._snapshot(barrier))
@@ -397,6 +418,16 @@ class SourceStreamTask(StreamTask):
                 self.stage_s["emit"] += emit_dt
                 self.io_timers.busy_s += emit_dt
                 self.progress.bump()
+                if TRACER.enabled:
+                    # one mailbox-loop cycle: read + chain/emit phases
+                    end = now_ms()
+                    (TRACER.span("task", "SourceBatch")
+                     .set_attribute("task", self.task_id)
+                     .set_attribute("records", batch.n)
+                     .set_attribute("read_ms", round(read_dt * 1e3, 3))
+                     .set_attribute("emit_ms", round(emit_dt * 1e3, 3))
+                     .set_start_ts(end - int((read_dt + emit_dt) * 1e3))
+                     .finish(end))
                 if adaptive:
                     # desired = throughput x target; EMA toward it. At the
                     # fixpoint one batch takes exactly target seconds.
@@ -481,11 +512,13 @@ class TwoInputStreamTask(StreamTask):
                                    list(snapshot.get("inflight2", ()))]
 
     def _complete_barrier(self, barrier: CheckpointBarrier) -> None:
+        sb = _barrier_spans(self.task_id, barrier)
         self._gate_barrier = [None, None]
         self.broadcast_all(barrier)
         snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
         self.reporter.acknowledge_checkpoint(
             self.task_id, barrier.checkpoint_id, snap)
+        sb.finish()
 
     def _on_barrier(self, gi: int, barrier: CheckpointBarrier) -> None:
         if self.gates[gi].capture_active:
@@ -631,15 +664,18 @@ class OneInputStreamTask(StreamTask):
             self.reporter.declined_checkpoint(
                 self.task_id, old_b.checkpoint_id,
                 "overtaken by a newer unaligned checkpoint")
+        sb = _barrier_spans(self.task_id, barrier)
         self.broadcast_all(barrier)
         snap = {"chain": self.chain.snapshot_state(barrier.checkpoint_id)}
         if self.gate.capture_active and not self.gate.capture_complete:
             self._unaligned_pending = (barrier, snap)
+            sb.set_attribute("unaligned", True).finish()
             return
         if self.gate.capture_active:  # capture already complete (1 channel)
             snap["inflight"] = self.gate.take_captured()
         self.reporter.acknowledge_checkpoint(
             self.task_id, barrier.checkpoint_id, snap)
+        sb.finish()
 
     def _maybe_finish_unaligned(self) -> None:
         if self._unaligned_pending is None:

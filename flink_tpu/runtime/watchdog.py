@@ -231,6 +231,17 @@ class Watchdog:
                     "deadline_s": deadline})
         from ..metrics.device import DEVICE_STATS
         DEVICE_STATS.note_watchdog_trip(site)
+        # the post-mortem moment: the stall span lands in the flight
+        # recorder's ring FIRST, then the dump snapshots the ring — the
+        # dump's tail always contains the stall site that triggered it
+        from ..metrics.tracing import TRACER, dump_flight_recorder
+        (TRACER.span("watchdog", "Stall")
+         .set_attribute("site", site)
+         .set_attribute("scope", scope)
+         .set_attribute("deadline_s", deadline)
+         .finish())
+        dump_flight_recorder("stall", site=site, scope=scope,
+                             deadline_s=deadline)
 
 
 #: The process-global watchdog every wrapped site consults.
