@@ -163,13 +163,30 @@ def _find_ops(env, cls):
     return ops
 
 
-def _n_panes(n_events: int, batch: int = BATCH) -> int:
+def _n_panes(n_events: int, batch: int = BATCH,
+             max_panes: int = RING - 7) -> int:
     """Panes sized so the WHOLE stream's event-time span plus the sliding
-    window's 4-pane tail fits inside the RING-slot accumulator ring with
-    headroom: worst-case open span = n_panes + 4 must stay < RING even if
-    fire retirement lags ingest completely (slow chip / congested tunnel /
-    CPU fallback). RING-7 panes -> max open span RING-3."""
-    return max(4, min(RING - 7, n_events // batch))
+    window's W-1-pane tail fits inside the ring-slot accumulator ring
+    with headroom: worst-case open span = n_panes + W - 1 must stay
+    <= ring - 3 even if fire retirement lags ingest completely (slow
+    chip / congested tunnel / CPU fallback). The default max_panes of
+    RING-7 is exactly that bound for the default RING ring and W=5; a
+    --window-panes sweep passes ring - W - 2 for the grown _ring_for()
+    ring so wide windows still see enough data panes to fill the full
+    merge width."""
+    return max(4, min(max_panes, n_events // batch))
+
+
+def _ring_for(window_panes: int) -> int:
+    """Ring size for a given window width: the default RING covers the
+    default W=5; wider windows (--window-panes sweep) grow the ring to
+    2W + 6 so W + 4 data panes fit under the open-span bound
+    (n_panes + W - 1 <= ring - 3) — a fire near the end of the stream
+    genuinely merges W live rows instead of being starved. Depends ONLY
+    on the width (never the event count) so a short warmup run compiles
+    the same shapes as the timed run; at W=5 this is byte-identical to
+    the seed RING."""
+    return max(RING, 2 * window_panes + 6)
 
 
 def _collect_stages(env) -> dict:
@@ -206,6 +223,10 @@ def _collect_metrics(env, before: dict) -> dict:
     out["recompiles"] = snap["compiles"] - before.get("compiles", 0)
     # degradation-ladder + stall counters (deltas for this run): nonzero
     # only under injection or a genuinely failing/hanging device path
+    # incremental fire engine + coalesced ingest counters (deltas)
+    for k in ("panes_sealed_total", "batches_coalesced_total",
+              "fire_merge_rows_read"):
+        out[k] = snap.get(k, 0) - before.get(k, 0)
     for k in ("device_retries_total", "device_degraded_total",
               "dead_letter_records_total", "injected_faults_total",
               "watchdog_trips_total", "stall_detections_total",
@@ -230,7 +251,8 @@ def _collect_metrics(env, before: dict) -> dict:
 def _run_q5(n_keys: int, n_events: int, capacity: int,
             pane_ms: int = 2000, topk: int = 1000, device: bool = True,
             batch: int = BATCH, metrics_registry=None,
-            extra_config: dict = None):
+            extra_config: dict = None, fire_mode: str = "full",
+            window_panes: int = 5):
     """One env.execute() of the Q5 pipeline; returns (wall_seconds,
     fire_latencies_ms, emitted_rows, stage_breakdown). The stage
     breakdown embeds the device-path metrics snapshot (compiles, cache
@@ -253,7 +275,9 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
 
     schema = Schema([("auction", np.int64), ("price", np.int64),
                      ("ts", np.int64)])
-    span = _n_panes(n_events, batch) * pane_ms
+    ring = _ring_for(window_panes)
+    n_panes = _n_panes(n_events, batch, max_panes=ring - window_panes - 2)
+    span = n_panes * pane_ms
 
     def gen(idx):
         u = idx.astype(np.uint64)
@@ -268,6 +292,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     env = StreamExecutionEnvironment.get_execution_environment()
     env.set_state_backend("tpu")
     env.config.set(PipelineOptions.BATCH_SIZE, batch)
+    env.config.set("window.fire.incremental", fire_mode == "incremental")
     for k, v in (extra_config or {}).items():
         env.config.set(k, v)
     ws = WatermarkStrategy.for_monotonous_timestamps() \
@@ -276,7 +301,8 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
                  watermark_strategy=ws, device=device)
         .key_by("auction")
-        .window(SlidingEventTimeWindows.of(5 * pane_ms, pane_ms))
+        .window(SlidingEventTimeWindows.of(window_panes * pane_ms,
+                                           pane_ms))
         # BASELINE config #3 is a SUM/COUNT aggregate: rank hot items by
         # bid COUNT (value_bits=31: exact to 2.1e9 events/key/window, and
         # <= 31 selects the int32 count plane + uint32 radix select) and
@@ -284,7 +310,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
         .device_aggregate([AggSpec("count", out_name="bids",
                                    value_bits=31),
                            AggSpec("sum", "price", out_name="revenue")],
-                          capacity=capacity, ring_size=RING,
+                          capacity=capacity, ring_size=ring,
                           emit_window_bounds=False, emit_topk=topk,
                           defer_overflow=True, async_fire=True)
         .add_sink(sink.fn, "count"))
@@ -296,25 +322,32 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     lat = [ms for o in ops for ms in o.fire_latencies_ms]
     stages = _collect_stages(env)
     stages.update(_collect_metrics(env, stats_before))
+    stages["fire_mode"] = fire_mode
+    stages["window_panes"] = window_panes
+    stages["max_inflight"] = max((o._max_inflight for o in ops), default=0)
     return wall, lat, sink.rows, stages
 
 
 def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
-                       device: bool = True):
+                       device: bool = True, fire_mode: str = "full",
+                       window_panes: int = 5):
     """Warmup run (compile) + timed run; returns (events/sec, p99 ms,
     stage breakdown). The timed run's ``recompiles`` must be 0: identical
     shapes after warmup hit the program caches, never the compiler."""
-    _run_q5(n_keys, min(n_events, 4 * BATCH), capacity,
-            device=device)                                  # compile warmup
+    _run_q5(n_keys, min(n_events, 4 * BATCH), capacity, device=device,
+            fire_mode=fire_mode,
+            window_panes=window_panes)                      # compile warmup
     wall, lat, _rows, stages = _run_q5(n_keys, n_events, capacity,
-                                       device=device)
+                                       device=device, fire_mode=fire_mode,
+                                       window_panes=window_panes)
     stages["wall"] = wall
     return n_events / wall, _p99(lat), stages
 
 
 def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
                 n_batches: int = 8, metrics_registry=None,
-                chaos_seed=None, extra_config: dict = None) -> dict:
+                chaos_seed=None, extra_config: dict = None,
+                fire_mode: str = "full", window_panes: int = 5) -> dict:
     """Tiny Q5 acceptance probe (tier-1 safe, no backend subprocess
     probe): warmup + timed run on whatever backend jax already has;
     returns the timed run's stage report with the embedded metrics
@@ -342,11 +375,14 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
         FAULTS.reset()  # arm fresh: visit counters start at zero
         WATCHDOG.reset()
     _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
-            metrics_registry=metrics_registry)              # compile warmup
+            metrics_registry=metrics_registry, fire_mode=fire_mode,
+            window_panes=window_panes)                      # compile warmup
     wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
                                       batch=batch,
                                       metrics_registry=metrics_registry,
-                                      extra_config=extra)
+                                      extra_config=extra,
+                                      fire_mode=fire_mode,
+                                      window_panes=window_panes)
     stages["wall"] = wall
     stages["events_per_sec"] = round(n_events / wall, 2)
     stages["p99_fire_latency_ms"] = round(_p99(lat), 3)
@@ -1010,16 +1046,21 @@ def _maybe_write_trace(stage: str) -> None:
         write_trace(stage)
 
 
-def tiny() -> None:
-    """`python bench.py --tiny`: the acceptance probe — one JSON line,
-    the tiny Q5 stage report with the metrics snapshot embedded."""
+def tiny(fire_mode: str = "full", window_panes_list=(5,)) -> None:
+    """`python bench.py --tiny [--fire-mode full|incremental]
+    [--window-panes N[,N...]]`: the acceptance probe — one JSON line per
+    window width, the tiny Q5 stage report with the metrics snapshot
+    embedded. Passing several widths sweeps them (seal/fire programs are
+    shared across widths, so only the first width compiles)."""
     probe = _ensure_backend()
     _emit_probe(probe)
-    stages = run_tiny_q5(extra_config=_trace_extra_config())
-    rec = {"metric": "nexmark_q5_tiny_stage_report", "unit": "report"}
-    rec.update({k: (round(v, 3) if isinstance(v, float) else v)
-                for k, v in stages.items()})
-    print(json.dumps(rec))
+    for wp in window_panes_list:
+        stages = run_tiny_q5(extra_config=_trace_extra_config(),
+                             fire_mode=fire_mode, window_panes=wp)
+        rec = {"metric": "nexmark_q5_tiny_stage_report", "unit": "report"}
+        rec.update({k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in stages.items()})
+        print(json.dumps(rec))
     _maybe_write_trace("tiny_q5")
     sys.stdout.flush()
 
@@ -1072,10 +1113,21 @@ if __name__ == "__main__":
         from flink_tpu.runtime.watchdog import WATCHDOG
         i = sys.argv.index("--probe-timeout")
         WATCHDOG.deadlines["bench.probe"] = float(sys.argv[i + 1])
+    _fire_mode = "full"
+    if "--fire-mode" in sys.argv:
+        i = sys.argv.index("--fire-mode")
+        _fire_mode = sys.argv[i + 1]
+        if _fire_mode not in ("full", "incremental"):
+            raise SystemExit(f"--fire-mode must be full|incremental, "
+                             f"got {_fire_mode!r}")
+    _window_panes = (5,)
+    if "--window-panes" in sys.argv:
+        i = sys.argv.index("--window-panes")
+        _window_panes = tuple(int(w) for w in sys.argv[i + 1].split(","))
     if "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:
-        tiny()
+        tiny(fire_mode=_fire_mode, window_panes_list=_window_panes)
     elif "--chaos" in sys.argv:
         i = sys.argv.index("--chaos")
         chaos(int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 0)

@@ -68,6 +68,14 @@ class DeviceStats:
         # tracing accounting (PR 7): spans evicted from the bounded
         # in-memory trace reporter (traces.max-retained)
         self._spans_dropped = 0
+        # incremental-fire / coalesced-ingest accounting (PR 8): panes
+        # folded into the running window accumulators (seals count 1,
+        # rebuilds count every live pane), upstream micro-batches merged
+        # into coalesced dispatches, and pane rows read per window fire
+        # (the O(W) vs O(1) distinction made measurable)
+        self._panes_sealed = 0
+        self._batches_coalesced = 0
+        self._fire_merge_rows = 0
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -186,6 +194,34 @@ class DeviceStats:
             self._net_errors[direction] = \
                 self._net_errors.get(direction, 0) + 1
 
+    # -- incremental-fire / coalescing accounting ----------------------------
+    def note_panes_sealed(self, n: int = 1) -> None:
+        with self._lock:
+            self._panes_sealed += int(n)
+
+    def note_batches_coalesced(self, n: int) -> None:
+        with self._lock:
+            self._batches_coalesced += int(n)
+
+    def note_fire_merge_rows(self, n: int) -> None:
+        with self._lock:
+            self._fire_merge_rows += int(n)
+
+    @property
+    def panes_sealed(self) -> int:
+        with self._lock:
+            return self._panes_sealed
+
+    @property
+    def batches_coalesced(self) -> int:
+        with self._lock:
+            return self._batches_coalesced
+
+    @property
+    def fire_merge_rows(self) -> int:
+        with self._lock:
+            return self._fire_merge_rows
+
     # -- tracing accounting --------------------------------------------------
     def note_spans_dropped(self, n: int = 1) -> None:
         with self._lock:
@@ -300,6 +336,9 @@ class DeviceStats:
                     sum(self._zombies_fenced.values()),
                 "network_errors_total": sum(self._net_errors.values()),
                 "spans_dropped_total": self._spans_dropped,
+                "panes_sealed_total": self._panes_sealed,
+                "batches_coalesced_total": self._batches_coalesced,
+                "fire_merge_rows_read": self._fire_merge_rows,
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -346,6 +385,9 @@ class DeviceStats:
             self._zombies_fenced.clear()
             self._net_errors.clear()
             self._spans_dropped = 0
+            self._panes_sealed = 0
+            self._batches_coalesced = 0
+            self._fire_merge_rows = 0
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -479,3 +521,10 @@ def bind_device_metrics(registry) -> None:
     g.gauge("network_errors_total", lambda: s.net_errors)
     # tracing (prometheus: flink_tpu_device_spans_dropped_total)
     g.gauge("spans_dropped_total", lambda: s.spans_dropped)
+    # incremental fire engine / coalesced ingest (prometheus:
+    # flink_tpu_device_panes_sealed_total /
+    # flink_tpu_device_batches_coalesced_total /
+    # flink_tpu_device_fire_merge_rows_read)
+    g.gauge("panes_sealed_total", lambda: s.panes_sealed)
+    g.gauge("batches_coalesced_total", lambda: s.batches_coalesced)
+    g.gauge("fire_merge_rows_read", lambda: s.fire_merge_rows)

@@ -23,6 +23,15 @@ sign-magnitude trick for floats), so one implementation covers every
 accumulator dtype. Invalid slots are excluded from both the histograms and
 the final compaction.
 
+Bounded non-negative integer domains (``value_bits <= 32`` — window
+COUNTs, packed price words, everything the Q5 fire ranks on) take a
+scatter-free bitwise-bisection path instead: the exact threshold is built
+bit by bit with one vectorized compare-and-count per bit, and the winners
+compact via cumsum + searchsorted. XLA lowers scatter to a serial loop,
+so dropping the histogram scatter-adds and the two compaction scatters
+makes the select several times faster at every size measured
+(0.47 ms vs 3.6 ms at n=16k, 37 ms vs 188 ms at n=1M; k=1000).
+
 Contract matches lax.top_k + validity: ``(values[k], indices[k], ok[k])``
 sorted descending; ``ok[i]`` False marks padding when fewer than k valid
 slots exist.
@@ -84,12 +93,19 @@ def masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
         passes = 4
     else:
         passes = max(1, -(-value_bits // 16))
-    if passes <= 2 and not jnp.issubdtype(jnp.asarray(values).dtype,
-                                          jnp.floating):
-        # non-negative integers below 2^32: the whole walk fits a uint32
-        # word — half the memory traffic of the uint64 path on every
-        # histogram/compare (the select is memory-bound at large n)
-        return _masked_topk_radix32(values, valid, k, passes)
+    if value_bits <= 32 and not jnp.issubdtype(jnp.asarray(values).dtype,
+                                               jnp.floating):
+        # non-negative integers below 2^32: bitwise threshold bisection —
+        # value_bits compare-and-count passes plus a searchsorted
+        # compaction, no scatter anywhere. XLA lowers scatter to a
+        # serial per-element loop, so the histogram walk's 65536-bin
+        # scatter-adds and the [n]->[k] compaction scatters dominate the
+        # radix path end to end (measured 3.6 ms vs 0.47 ms at n=16k and
+        # 188 ms vs 37 ms at n=1M for k=1000, value_bits=31 on one CPU
+        # host); the bisection is pure vectorized compare/reduce/gather
+        # and is also deterministic in its tie selection (index order),
+        # identically on every backend.
+        return _masked_topk_bisect(values, valid, k, value_bits)
     return _masked_topk_radix(values, valid, k, passes)
 
 
@@ -152,52 +168,55 @@ def _masked_topk_radix(values: jax.Array, valid: jax.Array, k: int,
     return buf_v[order], jnp.maximum(buf_i, 0)[order], filled[order]
 
 
-@partial(jax.jit, static_argnames=("k", "passes"))
-def _masked_topk_radix32(values: jax.Array, valid: jax.Array, k: int,
-                         passes: int = 2):
-    """uint32 radix walk for non-negative integer domains below 2^32
-    (value_bits <= 32): same threshold-select algorithm as the 64-bit
-    path, but every O(n) pass touches half the bytes, and the index
-    compaction runs in int32 (n < 2^31 always holds — capacities are
-    device-array sized)."""
+@partial(jax.jit, static_argnames=("k", "bits"))
+def _masked_topk_bisect(values: jax.Array, valid: jax.Array, k: int,
+                        bits: int = 32):
+    """Scatter-free exact top-k for non-negative integer domains below
+    2^bits: find the exact k-th largest value T by building it bit by bit
+    from the top — bit b joins the threshold iff at least kk candidates
+    still sit at or above ``T | (1 << b)`` — then compact the winners
+    with cumsum + searchsorted instead of scatters.
+
+    Every pass is one vectorized compare + masked count over [n]; the
+    compaction is two monotone-prefix binary searches of k targets. No
+    scatter appears anywhere, which on CPU (where XLA lowers scatter to
+    a serial loop) makes this several times faster than the histogram
+    radix walk at every measured size, and the arithmetic is plain
+    compare/reduce/gather that maps onto any backend identically.
+
+    Tie handling is exact and deterministic: every slot strictly above T
+    is included (provably fewer than kk of them), and remaining seats
+    fill with the lowest-index slots equal to T — ties are
+    interchangeable by definition, so this matches the radix contract."""
     n = values.shape[0]
     k = min(k, n)
     u = values.astype(jnp.uint32)
     nvalid = jnp.sum(valid, dtype=jnp.int32)
     kk = jnp.minimum(jnp.int32(k), nvalid)
-    cand = valid
-    above = jnp.int32(0)
-    prefix = jnp.uint32(0)
-    bins = jnp.arange(65536, dtype=jnp.int32)
-    for shift in (16, 0)[2 - passes:]:
-        field = ((u >> shift) & jnp.uint32(0xFFFF)).astype(jnp.int32)
-        hist = jnp.zeros(65536, jnp.int32).at[field].add(
-            cand.astype(jnp.int32))
-        revcum = jnp.cumsum(hist[::-1])[::-1]
-        cond = (above + revcum) >= kk
-        bstar = jnp.max(jnp.where(cond, bins, -1))
-        above = above + jnp.where(bins > bstar, hist, 0).sum()
-        prefix = prefix | (bstar.astype(jnp.uint32) << shift)
-        cand = cand & (field == bstar)
-    thr = prefix
+    thr = jnp.uint32(0)
+    for b in range(bits - 1, -1, -1):
+        cand = thr | (jnp.uint32(1) << b)
+        cnt = jnp.sum(valid & (u >= cand), dtype=jnp.int32)
+        thr = jnp.where(cnt >= kk, cand, thr)
     strict = valid & (u > thr)
     tie = valid & (u == thr)
     cum_s = jnp.cumsum(strict.astype(jnp.int32))
     cum_t = jnp.cumsum(tie.astype(jnp.int32))
-    tie_pos = jnp.clip(jnp.int32(k) - cum_t, 0, k - 1)
-    strict_pos = cum_s - 1
-    idx = jnp.arange(n, dtype=jnp.int32)
-    buf_i = jnp.full(k, -1, jnp.int32)
-    buf_i = buf_i.at[jnp.where(tie, tie_pos, k)].set(idx, mode="drop")
-    buf_i = buf_i.at[jnp.where(strict, strict_pos, k)].set(idx, mode="drop")
-    filled = buf_i >= 0
+    n_s = cum_s[-1]
+    # seat t (1-based): t-th strict slot while they last, then the
+    # (t - n_s)-th tie slot; searchsorted on the monotone prefix sums
+    # finds the index holding each rank without any scatter
+    targets = jnp.arange(1, k + 1, dtype=jnp.int32)
+    pos_s = jnp.searchsorted(cum_s, targets)
+    pos_t = jnp.searchsorted(cum_t, jnp.maximum(targets - n_s, 1))
+    idx = jnp.minimum(jnp.where(targets <= n_s, pos_s, pos_t), n - 1)
+    filled = targets <= kk
     sent = _sentinel(values.dtype)
-    buf_v = jnp.where(filled, values[jnp.maximum(buf_i, 0)], sent)
+    buf_v = jnp.where(filled, values[idx], sent)
     order = jnp.lexsort((jnp.where(filled, buf_v.astype(jnp.uint32),
                                    jnp.uint32(0)),
                          filled))[::-1]
-    return (buf_v[order], jnp.maximum(buf_i, 0)[order].astype(jnp.int64),
-            filled[order])
+    return (buf_v[order], idx[order].astype(jnp.int64), filled[order])
 
 
 def _sentinel(dtype):

@@ -34,8 +34,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.hash_table import EMPTY_KEY, ensure_x64, lookup_or_insert, \
     make_table
-from ..ops.segment_ops import AGG_INITS, AGG_MERGES, make_accumulator, \
-    scatter_fold
+from ..ops.segment_ops import AGG_COMBINE2, AGG_INITS, AGG_INVERT, \
+    AGG_MERGES, INVERTIBLE_KINDS, make_accumulator, merge_tree_build, \
+    merge_tree_update, pow2_ceil, scatter_fold
 from .exchange import keyby_exchange
 from .mesh import DATA_AXIS, device_index_for_key_groups, \
     key_groups_device, shard_ranges
@@ -107,6 +108,16 @@ class ShardedWindowAgg:
         self._fire = self._build_fire()
         self._retire = self._build_retire()
         self._fire_variants: dict = {}
+        # incremental fire engine plane split (window.fire.incremental):
+        # invertible aggregates keep a running [D, capacity] window
+        # accumulator; min/max keep a [D, 2L, capacity] binary merge tree
+        # over ring pane rows. L tracks the RING (not the window width) so
+        # the compiled seal/rebuild shapes are independent of W.
+        self.tree_size = pow2_ceil(ring)
+        self.inv_sig = tuple((a.kind, a.name) for a in self.aggs
+                             if a.kind in INVERTIBLE_KINDS)
+        self.tree_sig = tuple((a.kind, a.name) for a in self.aggs
+                              if a.kind not in INVERTIBLE_KINDS)
 
     # ------------------------------------------------------------------
     def init_state(self) -> ShardedWindowState:
@@ -283,6 +294,145 @@ class ShardedWindowAgg:
         return self._fire_full_program(rank_name, topk)(
             state, jnp.asarray(pane_rows, jnp.int32),
             jnp.asarray(rows_valid))
+
+    # -- incremental fire engine ---------------------------------------
+    def _inc_program(self, tag: tuple, builder):
+        cached = self._fire_variants.get(tag)
+        if cached is None:
+            cached = builder()
+            self._fire_variants[tag] = cached
+        return cached
+
+    def _build_seal_inc(self):
+        """ONE donated program per pane seal: for each invertible plane,
+        window' = (window ⊕ sealed pane) ⊖ retiring pane; for each merge
+        tree, clear the retiring leaf then write the sealed pane and
+        recompute both O(log L) ancestor paths. Returns the fire view
+        ([D, capacity] per plane) alongside the new planes — the fire
+        consumes the view without re-reading any ring row."""
+        inv_sig, tree_sig = self.inv_sig, self.tree_sig
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def seal(state: ShardedWindowState, wins: dict, trees: dict,
+                 new_row, sub_row, sub_valid, new_leaf, old_leaf):
+            view, new_wins, new_trees = {}, {}, {}
+            for kind, name in inv_sig:
+                arr = state.accs[name]                  # [D, ring, cap]
+                sealed = jnp.take(arr, new_row, axis=1)  # [D, cap]
+                fire_v = AGG_COMBINE2[kind](wins[name], sealed)
+                ident = AGG_INITS[kind](arr.dtype)
+                retire = jnp.where(sub_valid,
+                                   jnp.take(arr, sub_row, axis=1), ident)
+                new_wins[name] = AGG_INVERT[kind](fire_v, retire)
+                view[name] = fire_v
+            for kind, name in tree_sig:
+                arr = state.accs[name]
+                ident = jnp.full((arr.shape[0], arr.shape[2]),
+                                 AGG_INITS[kind](arr.dtype), arr.dtype)
+                # clear the retiring leaf FIRST: it can never be the pane
+                # being sealed (any two live panes differ by < L)
+                tree = jax.vmap(
+                    lambda t, v: merge_tree_update(kind, t, old_leaf, v)
+                )(trees[name], ident)
+                tree = jax.vmap(
+                    lambda t, v: merge_tree_update(kind, t, new_leaf, v)
+                )(tree, jnp.take(arr, new_row, axis=1))
+                new_trees[name] = tree
+                view[name] = tree[:, 1]
+            return view, new_wins, new_trees
+
+        return seal
+
+    def _build_rebuild_inc(self):
+        """Re-derive the incremental planes from the pane accumulators in
+        one dispatch (restore, degrade, fire-boundary jump, or a write
+        into an already-sealed pane). ``pane_rows``/``pane_leaves`` are
+        padded to [ring] so the program shape is window-width-independent;
+        padding rows carry leaf index L and drop out of the scatter."""
+        inv_sig, tree_sig, L = self.inv_sig, self.tree_sig, self.tree_size
+
+        @jax.jit
+        def rebuild(state: ShardedWindowState, pane_rows, rows_valid,
+                    pane_leaves, sub_row, sub_valid):
+            view, new_wins, new_trees = {}, {}, {}
+            for kind, name in inv_sig:
+                arr = state.accs[name]
+                ident = AGG_INITS[kind](arr.dtype)
+                sub = jnp.where(rows_valid[None, :, None],
+                                arr[:, pane_rows, :], ident)
+                fire_v = AGG_MERGES[kind](sub, axis=1)   # [D, cap]
+                retire = jnp.where(sub_valid,
+                                   jnp.take(arr, sub_row, axis=1), ident)
+                new_wins[name] = AGG_INVERT[kind](fire_v, retire)
+                view[name] = fire_v
+            for kind, name in tree_sig:
+                arr = state.accs[name]
+                ident = AGG_INITS[kind](arr.dtype)
+                rows = jnp.where(rows_valid[None, :, None],
+                                 arr[:, pane_rows, :], ident)
+                leaves = jnp.full((arr.shape[0], L, arr.shape[2]), ident,
+                                  arr.dtype)
+                idx = jnp.where(rows_valid, pane_leaves, L)
+                leaves = leaves.at[:, idx, :].set(rows, mode="drop")
+                tree = jax.vmap(lambda lv: merge_tree_build(kind, lv))(
+                    leaves)
+                new_trees[name] = tree
+                view[name] = tree[:, 1]
+            return view, new_wins, new_trees
+
+        return rebuild
+
+    def _build_fire_inc(self, rank_name: Optional[str],
+                        topk: Optional[int]):
+        """The fused fire over an incremental view: emit mask + optional
+        global top-k + health scalars — identical output structure to
+        _build_fire_full, but reading [D, capacity] views instead of
+        merging W ring rows."""
+        count_name = next(a.name for a in self.aggs if a.kind == "count")
+
+        @jax.jit
+        def fire(state: ShardedWindowState, view: dict):
+            count = view[count_name]
+            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+            occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
+            dropped = state.dropped.sum()
+            if topk is None:
+                return state.table, emit, view, dropped, occ
+            rank = view[rank_name]
+            _vals, flat_idx, ok = global_topk(rank, emit, topk)
+            keys = jnp.take(state.table.reshape(-1), flat_idx)
+            res = {n: jnp.take(v.reshape(-1), flat_idx)
+                   for n, v in view.items()}
+            return keys, ok, res, dropped, occ
+
+        return fire
+
+    def seal_inc(self, state: ShardedWindowState, wins: dict, trees: dict,
+                 new_row: int, sub_row: int, sub_valid: bool,
+                 new_leaf: int, old_leaf: int):
+        """Seal one pane into the incremental planes (wins/trees are
+        donated) and return (fire view, new wins, new trees)."""
+        return self._inc_program(("inc_seal",), self._build_seal_inc)(
+            state, wins, trees, jnp.int32(new_row), jnp.int32(sub_row),
+            jnp.bool_(sub_valid), jnp.int32(new_leaf), jnp.int32(old_leaf))
+
+    def rebuild_inc(self, state: ShardedWindowState, pane_rows: np.ndarray,
+                    rows_valid: np.ndarray, pane_leaves: np.ndarray,
+                    sub_row: int, sub_valid: bool):
+        """Rebuild the incremental planes from the pane accumulators;
+        same return shape as seal_inc."""
+        return self._inc_program(("inc_rebuild",), self._build_rebuild_inc)(
+            state, jnp.asarray(pane_rows, jnp.int32),
+            jnp.asarray(rows_valid), jnp.asarray(pane_leaves, jnp.int32),
+            jnp.int32(sub_row), jnp.bool_(sub_valid))
+
+    def fire_inc(self, state: ShardedWindowState, view: dict,
+                 rank_name: Optional[str], topk: Optional[int]):
+        """Dispatch the fused incremental fire; returns device outputs
+        (same structure as fire_compact) without synchronizing."""
+        return self._inc_program(
+            ("inc_fire", rank_name, topk),
+            lambda: self._build_fire_inc(rank_name, topk))(state, view)
 
     # ------------------------------------------------------------------
     def _build_retire(self):

@@ -193,14 +193,22 @@ def _dedup_first(table, present, last_ts, keys, valid, ts, ttl_ms):
 
 
 class _ArrayState:
-    __slots__ = ("name", "kind", "dtype", "ring", "array")
+    __slots__ = ("name", "kind", "dtype", "ring", "array", "role")
 
     def __init__(self, name: str, kind: str, dtype, ring: Optional[int],
-                 capacity: int):
+                 capacity: int, role: str = "pane"):
         self.name = name
         self.kind = kind
         self.dtype = dtype
         self.ring = ring
+        # role "pane" (default): source-of-truth pane accumulators — they
+        # snapshot, spill, retire and conform. role "window": DERIVED
+        # incremental-fire state (running window accumulators / merge-tree
+        # planes). Window planes follow slot remaps (rehash/growth) but are
+        # excluded from snapshots, the host spill tier, ring-row
+        # retirement and conform_ring — a restore simply rebuilds them
+        # from the pane planes.
+        self.role = role
         shape = (ring, capacity) if ring else (capacity,)
         self.array = make_accumulator(kind, shape, dtype)
 
@@ -463,24 +471,25 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def _sync_mirror_inner(self) -> None:
         nb, bs = self._n_blocks, self._block
         self.last_snapshot_dma_bytes = 0
+        snap_states = self._snapshot_states()
         if self._mirror is None:
             # writable copies: device_get may return read-only views
             t = np.array(jax.device_get(self.table))
             arrs = {n: np.array(jax.device_get(st.array))
-                    for n, st in self._array_states.items()}
+                    for n, st in snap_states}
             self._mirror = {"table": t, "arrays": arrs}
             self.last_snapshot_dma_bytes = t.nbytes + sum(
                 a.nbytes for a in arrs.values())
         else:
             arrs = self._mirror["arrays"]
-            for n, st in self._array_states.items():
+            for n, st in snap_states:
                 if n not in arrs:
                     a = np.array(jax.device_get(st.array))
                     arrs[n] = a
                     self.last_snapshot_dma_bytes += a.nbytes
             # ① replay ring-row retirements host-side (no DMA)
             for row in self._retired_rows:
-                for n, st in self._array_states.items():
+                for n, st in snap_states:
                     if st.ring:
                         arrs[n][row, :] = np.asarray(
                             AGG_INITS[st.kind](st.array.dtype))
@@ -491,7 +500,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             if len(blocks):
                 bidx = jnp.asarray(blocks)
                 parts = {"__table__": self.table.reshape(nb, bs)[bidx]}
-                for n, st in self._array_states.items():
+                for n, st in snap_states:
                     if st.ring:
                         parts[n] = st.array.reshape(
                             st.array.shape[0], nb, bs)[:, bidx]
@@ -502,7 +511,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                     np.asarray(v).nbytes for v in host.values())
                 self._mirror["table"].reshape(nb, bs)[blocks] = \
                     np.asarray(host["__table__"])
-                for n, st in self._array_states.items():
+                for n, st in snap_states:
                     a, p = arrs[n], np.asarray(host[n])
                     if st.ring:
                         a.reshape(a.shape[0], nb, bs)[:, blocks] = p
@@ -642,7 +651,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def _ensure_host_tier(self) -> HostTier:
         if self._host is None:
             self._host = HostTier(self.max_parallelism)
-        for name, st in self._array_states.items():
+        for name, st in self._snapshot_states():
             self._host.register(name, st.kind, np.dtype(jnp.dtype(st.dtype)),
                                 st.ring)
         return self._host
@@ -667,7 +676,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         host = self._ensure_host_tier()
         if sel.any():
             values = {}
-            for name, st in self._array_states.items():
+            for name, st in self._snapshot_states():
                 arr = np.asarray(jax.device_get(st.array))
                 values[name] = (arr[:, slots_dev[sel]] if st.ring
                                 else arr[slots_dev[sel]])
@@ -710,13 +719,26 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                       np.asarray(ring_idx) if st.ring else None)
 
     def register_array_state(self, name: str, kind: str, dtype,
-                             ring: Optional[int] = None) -> None:
+                             ring: Optional[int] = None,
+                             role: str = "pane") -> None:
         if name not in self._array_states:
             self._array_states[name] = _ArrayState(name, kind, dtype, ring,
-                                                   self.capacity)
-            if self._host is not None:
+                                                   self.capacity, role)
+            if self._host is not None and role != "window":
                 self._host.register(name, kind,
                                     np.dtype(jnp.dtype(dtype)), ring)
+
+    def has_array(self, name: str) -> bool:
+        return name in self._array_states
+
+    def drop_array_state(self, name: str) -> None:
+        self._array_states.pop(name, None)
+
+    def _snapshot_states(self):
+        """(name, state) pairs that participate in snapshots/mirror/spill —
+        everything except derived window-role planes."""
+        return [(n, st) for n, st in self._array_states.items()
+                if st.role != "window"]
 
     def get_array(self, name: str) -> jax.Array:
         return self._array_states[name].array
@@ -767,7 +789,8 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         stage — measured 7.7s of an 8.4s Q5@1M fire budget on CPU).
         The host knows the retired row, so the snapshot mirror replays it
         without marking anything dirty on device."""
-        ring_states = [st for st in self._array_states.values() if st.ring]
+        ring_states = [st for st in self._array_states.values()
+                       if st.ring and st.role != "window"]
         if ring_states:
             sig = tuple((st.kind, str(st.array.dtype), st.array.shape)
                         for st in ring_states)
@@ -843,7 +866,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         identity (retired). No-op when sizes already match."""
         live = list(live_panes)
         for st in self._array_states.values():
-            if not st.ring or st.ring == ring:
+            if not st.ring or st.ring == ring or st.role == "window":
                 continue
             if len(live) > ring:
                 raise RuntimeError(
@@ -1066,7 +1089,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             groups = np.concatenate([groups, key_groups_for_hash_batch(
                 hash_batch(host_keys), self.max_parallelism)])
         states = {}
-        for name, st in self._array_states.items():
+        for name, st in self._snapshot_states():
             arr = self._mirror["arrays"][name]
             vals = arr[:, slots] if st.ring else arr[slots]
             if host_vals is not None:
