@@ -1046,12 +1046,41 @@ def _maybe_write_trace(stage: str) -> None:
         write_trace(stage)
 
 
-def tiny(fire_mode: str = "full", window_panes_list=(5,)) -> None:
+def _audit_report() -> dict:
+    """tpu-lint Tier-B jaxpr audit over every compiled program the run
+    just registered (metrics.device PROGRAM_AUDIT): per-rule finding
+    counts plus the count not covered by the committed baseline.  The
+    tiny Q5 report must show audit_new == 0 — a scatter on the fire
+    path or an f64 leak fails the acceptance probe, not a code review."""
+    from flink_tpu.analysis import (AnalysisContext, all_rules,
+                                    diff_against_baseline, run_rules)
+    from flink_tpu.metrics.device import PROGRAM_AUDIT
+
+    tier_b = sorted(r for r, rr in all_rules().items() if rr.tier == "B")
+    skipped: list = []
+    findings = run_rules(AnalysisContext(), tier_b, skipped)
+    new, _stale = diff_against_baseline(findings)
+    counts = {r: 0 for r in tier_b}
+    for f in findings:
+        counts[f.rule] += 1
+    report = {f"audit_{r}": n for r, n in counts.items()}
+    report["audit_programs"] = len(PROGRAM_AUDIT)
+    report["audit_new"] = len(new)
+    if skipped:
+        report["audit_skipped"] = skipped
+    return report
+
+
+def tiny(fire_mode: str = "full", window_panes_list=(5,),
+         audit: bool = False) -> None:
     """`python bench.py --tiny [--fire-mode full|incremental]
-    [--window-panes N[,N...]]`: the acceptance probe — one JSON line per
-    window width, the tiny Q5 stage report with the metrics snapshot
-    embedded. Passing several widths sweeps them (seal/fire programs are
-    shared across widths, so only the first width compiles)."""
+    [--window-panes N[,N...]] [--audit]`: the acceptance probe — one
+    JSON line per window width, the tiny Q5 stage report with the
+    metrics snapshot embedded. Passing several widths sweeps them
+    (seal/fire programs are shared across widths, so only the first
+    width compiles). ``--audit`` runs the tpu-lint Tier-B jaxpr audit
+    over the programs the run compiled and embeds per-rule finding
+    counts."""
     probe = _ensure_backend()
     _emit_probe(probe)
     for wp in window_panes_list:
@@ -1060,6 +1089,8 @@ def tiny(fire_mode: str = "full", window_panes_list=(5,)) -> None:
         rec = {"metric": "nexmark_q5_tiny_stage_report", "unit": "report"}
         rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in stages.items()})
+        if audit:
+            rec.update(_audit_report())
         print(json.dumps(rec))
     _maybe_write_trace("tiny_q5")
     sys.stdout.flush()
@@ -1127,7 +1158,12 @@ if __name__ == "__main__":
     if "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:
-        tiny(fire_mode=_fire_mode, window_panes_list=_window_panes)
+        tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,
+             audit="--audit" in sys.argv)
+    elif "--audit" in sys.argv:
+        # audit alone: the tiny acceptance probe with the jaxpr audit on
+        tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,
+             audit=True)
     elif "--chaos" in sys.argv:
         i = sys.argv.index("--chaos")
         chaos(int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 0)

@@ -1,0 +1,38 @@
+"""tpu-lint: static analysis for the device-path invariants.
+
+Two tiers (SURVEY.md §7 "enforce by machine, not convention"):
+
+* Tier A — AST passes over the whole package: host-sync discipline in
+  hot-path modules, singleton wiring on deploy entry points, inventory
+  locks (spans / fault sites / config keys vs. code and docs), lock
+  discipline, determinism (wall-clock + RNG).
+* Tier B — jaxpr program audit: abstractly re-trace every compiled-
+  segment builder registered through ``instrumented_program_cache`` and
+  lint the program IR itself (scatter lowering on the fire path, f64
+  leaks, missing donation, value-derived cache keys).
+
+Findings carry file:line + rule id + fix hint and diff against the
+committed ``flink_tpu/analysis/baseline.json``; any unbaselined finding
+fails the tier-1 ``lint``-marked test (tests/test_analysis.py) and the
+``python -m flink_tpu.cli lint`` subcommand.
+
+See docs/ANALYSIS.md for the rule catalogue and suppression syntax.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    Rule,
+    all_rules,
+    baseline_path,
+    diff_against_baseline,
+    load_baseline,
+    run_rules,
+    rule,
+    save_baseline,
+)
+
+# Importing the rule modules registers their rules.
+from . import ast_rules  # noqa: F401,E402
+from . import inventory  # noqa: F401,E402
+from . import jaxpr_rules  # noqa: F401,E402

@@ -379,6 +379,77 @@ def _cmd_cluster(args) -> int:
         return 0
 
 
+def _cmd_lint(args) -> int:
+    """tpu-lint driver: Tier-A AST rules + Tier-B jaxpr program audit,
+    diffed against the committed baseline (flink_tpu/analysis/
+    baseline.json).  Exit 0 clean, 1 unbaselined/stale findings, 2
+    usage error."""
+    import json as _json
+
+    from .analysis import (AnalysisContext, all_rules,
+                           diff_against_baseline, run_rules,
+                           save_baseline)
+
+    known = all_rules()
+    if args.rules:
+        selected = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in selected if r not in known]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+    else:
+        selected = sorted(known)
+
+    skipped: list[str] = []
+    if any(known[r].tier == "B" for r in selected):
+        # The jaxpr audit lints programs a pipeline actually built:
+        # exercise a tiny Q5-shaped job to populate the registry.
+        from .metrics.device import PROGRAM_AUDIT
+        if not PROGRAM_AUDIT:
+            try:
+                from .analysis.jaxpr_rules import exercise_programs
+                exercise_programs()
+            except Exception as e:
+                skipped.append(f"tier-B program exercise failed: {e}")
+
+    ctx = AnalysisContext()
+    findings = run_rules(ctx, selected, skipped)
+    new, stale = diff_against_baseline(findings)
+
+    if args.update_baseline:
+        save_baseline(findings)
+        print(f"baseline updated: {len(findings)} entries "
+              f"({len(new)} need a reviewed reason)")
+        return 0
+
+    if args.json:
+        print(_json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.fingerprint for f in new],
+            "stale_baseline": stale,
+            "skipped": skipped}, indent=2, sort_keys=True))
+    else:
+        new_fps = {f.fingerprint for f in new}
+        if findings:
+            rows = [[f.rule,
+                     "NEW" if f.fingerprint in new_fps else "baselined",
+                     f.location(), f.message] for f in findings]
+            _print_table(["rule", "status", "location", "finding"],
+                         rows, max_rows=200)
+            for f in new:
+                if f.hint:
+                    print(f"  {f.rule} {f.location()}: hint: {f.hint}")
+        for s in skipped:
+            print(f"skipped: {s}")
+        for e in stale:
+            print(f"stale baseline entry (fixed? run --update-baseline): "
+                  f"{e['rule']} {e['file']} {e['symbol']}")
+        print(f"{len(findings)} finding(s), {len(new)} new, "
+              f"{len(stale)} stale baseline entr(y/ies)")
+    return 1 if (new or stale) else 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="flink-tpu", description="flink-tpu command line client")
@@ -470,6 +541,18 @@ def main(argv: Optional[list[str]] = None) -> int:
     sql.add_argument("--parallelism", type=int, default=0)
     sql.add_argument("--max-rows", type=int, default=100)
     sql.set_defaults(fn=_cmd_sql)
+
+    lint = sub.add_parser(
+        "lint", help="tpu-lint: device-path static analysis "
+                     "(AST rules + jaxpr program audit)")
+    lint.add_argument("--rules", help="comma-separated rule ids "
+                                      "(default: all)")
+    lint.add_argument("--json", action="store_true",
+                      help="machine-readable output")
+    lint.add_argument("--update-baseline", action="store_true",
+                      help="rewrite flink_tpu/analysis/baseline.json "
+                           "from the current findings")
+    lint.set_defaults(fn=_cmd_lint)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=lambda a: (print("flink-tpu 0.1"), 0)[1])

@@ -287,7 +287,8 @@ class _Coordinator:
                     elif not self._maybe_restart(
                             [], f"task failure on host {msg['host_id']}: "
                                 f"{msg.get('error', 'unknown')}"):
-                        self.failed = msg.get("error", "unknown")
+                        with self._lock:
+                            self.failed = msg.get("error", "unknown")
                         self.broadcast({"type": "cancel"})
         except OSError:
             pass
@@ -564,8 +565,9 @@ class _Coordinator:
             # checkpoints existed but none verifies: redeploying from
             # scratch would replay the whole stream past committed output
             # — fail the job with the typed corruption error instead
-            self.failed = (f"{reason}; CorruptArtifactError: all retained "
-                           "checkpoints failed verification")
+            with self._lock:
+                self.failed = (f"{reason}; CorruptArtifactError: all "
+                               "retained checkpoints failed verification")
             self.broadcast({"type": "cancel"})
             restart_sb.set_attribute("error", True).finish()
             return
@@ -618,11 +620,13 @@ class _Coordinator:
                 # strategy's escalation (backoff returns to initial) —
                 # without this, one bad hour a week escalates forever
                 self._strategy.notify_recovered()
-                self._last_restart_ts = 0.0
+                with self._lock:
+                    self._last_restart_ts = 0.0
             if dead and self.failed is None:
                 if not self._maybe_restart(
                         dead, f"worker(s) {dead} missed heartbeats"):
-                    self.failed = f"worker(s) {dead} missed heartbeats"
+                    with self._lock:
+                        self.failed = f"worker(s) {dead} missed heartbeats"
                     self.broadcast({"type": "cancel"})
             if self.all_finished():
                 with self._lock:

@@ -25,7 +25,8 @@ import time
 from typing import Any, Callable, Optional
 
 __all__ = ["DeviceStats", "DEVICE_STATS", "instrumented_program_cache",
-           "bind_device_metrics", "set_compile_tracer", "pytree_nbytes"]
+           "bind_device_metrics", "set_compile_tracer", "pytree_nbytes",
+           "PROGRAM_AUDIT", "ProgramAuditEntry", "clear_program_audit"]
 
 
 class DeviceStats:
@@ -414,27 +415,96 @@ def pytree_nbytes(tree) -> int:
     return total
 
 
+class ProgramAuditEntry:
+    """One compiled program captured for the tpu-lint Tier-B jaxpr audit
+    (flink_tpu/analysis/jaxpr_rules.py): the jitted callable plus the
+    abstract (shape/dtype) signature of its first dispatch, so the audit
+    can re-trace it without real buffers, and the builder-arg key so
+    value-derived cache keys are detectable."""
+
+    __slots__ = ("scope", "fn", "abstract_args", "abstract_kwargs",
+                 "build_key", "source")
+
+    def __init__(self, scope, fn, abstract_args, abstract_kwargs,
+                 build_key, source):
+        self.scope = scope
+        self.fn = fn
+        self.abstract_args = abstract_args
+        self.abstract_kwargs = abstract_kwargs
+        self.build_key = build_key
+        self.source = source  # (filename, lineno) of the underlying fn
+
+
+# Every instrumented program's first dispatch appends its audit entry
+# here; `python -m flink_tpu.cli lint` / `bench.py --audit` read it after
+# exercising a pipeline.  Bounded so a pathological builder loop cannot
+# grow it without limit.
+PROGRAM_AUDIT: list = []  # lint: guarded-by GIL-atomic append/clear; read offline by the Tier-B audit
+_PROGRAM_AUDIT_LIMIT = 512
+
+
+def clear_program_audit() -> None:
+    PROGRAM_AUDIT.clear()
+
+
+def _program_source(fn):
+    inner = getattr(fn, "__wrapped__", fn)
+    code = getattr(inner, "__code__", None)
+    if code is None:
+        return None
+    return (code.co_filename, code.co_firstlineno)
+
+
+def _record_program_audit(scope, fn, args, kwargs, build_key) -> None:
+    """Capture the abstract signature of a program's first dispatch.
+    Non-fatal by design: the audit is an observer, never a reason for a
+    dispatch to fail."""
+    if len(PROGRAM_AUDIT) >= _PROGRAM_AUDIT_LIMIT:
+        return
+    try:
+        import jax
+
+        def _abs(x):
+            shape = getattr(x, "shape", None)
+            dtype = getattr(x, "dtype", None)
+            if shape is not None and dtype is not None:
+                return jax.ShapeDtypeStruct(tuple(shape), dtype)
+            return x
+
+        PROGRAM_AUDIT.append(ProgramAuditEntry(
+            scope, fn,
+            jax.tree_util.tree_map(_abs, args),
+            jax.tree_util.tree_map(_abs, kwargs),
+            build_key, _program_source(fn)))
+    except Exception:
+        pass
+
+
 class _TimedProgram:
     """Times the FIRST dispatch of a freshly-built program — jax.jit
     traces/lowers/compiles synchronously inside that call, so its wall
     clock IS the compile cost; later calls pay one extra branch."""
 
-    __slots__ = ("_fn", "_scope", "_compiled")
+    __slots__ = ("_fn", "_scope", "_compiled", "_build_key")
 
-    def __init__(self, fn, scope: str):
+    def __init__(self, fn, scope: str, build_key: str = ""):
         self._fn = fn
         self._scope = scope
         self._compiled = False
+        self._build_key = build_key
 
     def __call__(self, *args, **kwargs):
         if self._compiled:
             return self._fn(*args, **kwargs)
-        start_ms = int(time.time() * 1000)
+        from .tracing import now_ms
+        start_ms = now_ms()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         self._compiled = True
         DEVICE_STATS.note_compile_done(
             self._scope, (time.perf_counter() - t0) * 1e3, start_ms)
+        _record_program_audit(self._scope, self._fn, args, kwargs,
+                              self._build_key)
         return out
 
 
@@ -460,7 +530,9 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
                 from ..runtime.faults import fire_with_retries
                 fire_with_retries("device.compile", scope=scope)
                 DEVICE_STATS.note_build(scope)
-                return _TimedProgram(builder(*args, **kwargs), scope)
+                key = repr((args, tuple(sorted(kwargs.items()))))
+                return _TimedProgram(builder(*args, **kwargs), scope,
+                                     build_key=key)
 
             return WATCHDOG.run("device.compile", _build, scope=scope)
 

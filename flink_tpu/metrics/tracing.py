@@ -58,7 +58,7 @@ __all__ = [
 # Epoch offset sampled once at import: now_ms() is monotonic-derived but
 # reports epoch milliseconds, so durations are immune to wall-clock steps
 # while start times still line up with log timestamps.
-_EPOCH_OFFSET_MS = time.time() * 1000.0 - time.monotonic() * 1000.0
+_EPOCH_OFFSET_MS = time.time() * 1000.0 - time.monotonic() * 1000.0  # lint: wall-clock-ok sampled ONCE at import to anchor the monotonic clock
 
 
 def now_ms() -> int:

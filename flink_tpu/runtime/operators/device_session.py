@@ -341,6 +341,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
         self._backend: Optional[TpuKeyedStateBackend] = None
         self._registered = False
         self._late_dropped = 0
+        self._late_cached = 0
         self._fired_boundary = _NEG
         self.fire_latencies_ms: list[float] = []
         self.stage_s = {"ingest": 0.0, "fire": 0.0, "drain": 0.0}
@@ -472,11 +473,13 @@ class DeviceSessionWindowOperator(OneInputOperator):
             self._backend.set_array(n_, arr)
         self._backend.set_array("__cur_lane__", cur_lane)
         self._backend._dropped = dropped
+        # lint: sync-ok emitted-count gate per batch; bounds the d2h slice
         g = int(jax.device_get(n_emit))
         if g:
             span = min(pow2_ceil(g), P)
             host = stall_bounded(
                 "transfer.d2h",
+                # lint: sync-ok session emit drain, one d2h per emitting batch
                 lambda: jax.device_get(
                     {"k": ekey[:span], "s": estart[:span],
                      "e": eend[:span], "c": ecount[:span],
@@ -548,6 +551,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
                 lambda: fire(self._backend.table, planes,
                              np.int64(boundary)),
                 scope="device_session")
+            # lint: sync-ok fire loop control (fired/overflow counts)
             fired_h, overflow_h = map(int, jax.device_get(
                 (fired, overflow)))
             if fired_h == 0:
@@ -557,6 +561,7 @@ class DeviceSessionWindowOperator(OneInputOperator):
             span = min(pow2_ceil(fired_h), self._backend.capacity)
             host = stall_bounded(
                 "transfer.d2h",
+                # lint: sync-ok session fire drain, one d2h per fire round
                 lambda: jax.device_get(
                     {"k": keys[:span], "s": start[:span], "e": end[:span],
                      "o": {n_: v[:span] for n_, v in outs.items()}}),
@@ -566,6 +571,8 @@ class DeviceSessionWindowOperator(OneInputOperator):
             if overflow_h == 0:
                 break
         # deferred health: table overflow / lane collisions raise here
+        self._refresh_late()
+        # lint: sync-ok deferred overflow health check, once per fire
         dropped = int(jax.device_get(self._backend.dropped_device))
         if dropped:
             raise RuntimeError(
@@ -597,15 +604,24 @@ class DeviceSessionWindowOperator(OneInputOperator):
         schema = Schema(fields)
         self.output.emit(RecordBatch(schema, cols, end - 1))
 
+    def _refresh_late(self) -> None:
+        """Refresh the host cache of the device late-drop counter at
+        fire/checkpoint boundaries — a /metrics scrape reads the cache
+        alone and never forces a device sync mid-pipeline (the PR 8
+        late_dropped lesson, applied to sessions too)."""
+        # lint: sync-ok boundary-amortized refresh; scrapes read the cache
+        self._late_cached = int(jax.device_get(self._late_dev))
+
     @property
     def late_dropped(self) -> int:
-        return self._late_dropped + int(jax.device_get(self._late_dev))
+        return self._late_dropped + self._late_cached
 
     def finish(self) -> None:
         pass
 
     # -- checkpointing -----------------------------------------------------
     def snapshot_state(self, checkpoint_id: int) -> dict:
+        self._refresh_late()
         return {"keyed": {
             "backend": self._backend.snapshot(checkpoint_id),
             "pending": [dict(c) for c in self._pending],

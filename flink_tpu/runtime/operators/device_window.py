@@ -741,6 +741,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             self._pre_fire_flush()
             snap = self._backend.snapshot(-1)
             if self._late_dev is not None:
+                # lint: sync-ok degrade path: final drain of the device counter, once per degrade
                 self._late_dropped += int(jax.device_get(self._late_dev))
                 self._late_dev = None
                 self._late_cached = 0
@@ -900,6 +901,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         self._coalesce_flush()
         if self._stage is None:
             return
+        # lint: sync-ok spill-stage drain gate, once per fire boundary
         cnt = int(jax.device_get(self._stage["count"]))
         if cnt == 0:
             return
@@ -910,6 +912,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
                    self._stage_slots)
         host = stall_bounded(
             "transfer.d2h",
+            # lint: sync-ok spill-stage drain, one bounded d2h per fire boundary
             lambda: jax.device_get({k: v[:span]
                                     for k, v in self._stage.items()
                                     if k != "count"}),
@@ -929,6 +932,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         """A host-column view of a batch (CPU fallback: device arrays ARE
         host buffers, so np.asarray is a view, not a transfer)."""
         if isinstance(batch, DeviceRecordBatch):
+            # lint: sync-ok CPU-fallback view: np.asarray of a host-backed buffer is zero-copy
             cols = {f.name: np.asarray(batch.device_column(f.name))
                     for f in batch.schema.fields}
             ts = np.asarray(batch.dtimestamps
@@ -1289,9 +1293,11 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             # ONE deadline-bounded transfer for everything (device_get is
             # idempotent: a stall-abandoned read re-runs safely)
             host = stall_bounded("transfer.d2h",
+                                 # lint: sync-ok fire materialization: the one amortized d2h per pane fire
                                  lambda: jax.device_get(outs),
                                  scope="device_window")
         else:
+            # lint: sync-ok degraded-mode fire materialization (host buffers, a view)
             host = jax.device_get(outs)   # degraded: host buffers, a view
         d2h_bytes = pytree_nbytes(host)
         if self._topk is not None:
@@ -1377,6 +1383,7 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             return
         ready = getattr(self._late_dev, "is_ready", None)
         if block or ready is None or ready():
+            # lint: sync-ok boundary-amortized refresh; scrapes read the cache (ISSUE 8)
             self._late_cached = int(jax.device_get(self._late_dev))
 
     @property

@@ -254,12 +254,14 @@ class DeviceGroupAggOperator(OneInputOperator):
         for n, arr in out.items():
             self._backend.set_array(n, arr)
         self._backend.set_dirty_mask(dirty)
+        # lint: sync-ok changelog-emit gate per batch; bounds the d2h slice
         g = int(jax.device_get(n_groups))
         if g == 0:
             return
         span = min(1 << (g - 1).bit_length() if g > 1 else 1, P)
         host = stall_bounded(
             "transfer.d2h",
+            # lint: sync-ok group-agg changelog drain, one bounded d2h per batch
             lambda: jax.device_get({
                 "idx": row_idx[:span],
                 "prev": {n: v[:span] for n, v in comp_prev.items()},

@@ -1,0 +1,402 @@
+"""tpu-lint: the tier-1 lint gate plus seeded regression proofs that
+every rule actually fires.
+
+Two families:
+
+* Gate tests (``pytest -m lint``): run the full rule suite against THIS
+  tree and fail on any finding the committed baseline does not cover —
+  the mechanical form of "the device-path invariants hold".
+* Seeded tests: synthetic mini-packages (tmp_path) with one injected
+  violation each — a ``float(device_val)`` in a hot path, a deploy path
+  missing a singleton, a config-key typo, an un-locked mutation, a
+  scatter-bearing / f64 / undonated / value-keyed program — proving
+  each rule detects its violation with the right rule id and file:line.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from flink_tpu.analysis import (
+    AnalysisContext,
+    all_rules,
+    diff_against_baseline,
+    load_baseline,
+    run_rules,
+)
+from flink_tpu.analysis.core import AnalysisSettings, Finding
+
+pytestmark = pytest.mark.lint
+
+TIER_A = sorted(r for r, rr in all_rules().items() if rr.tier == "A")
+TIER_B = sorted(r for r, rr in all_rules().items() if rr.tier == "B")
+
+
+def _fmt(findings):
+    return "\n".join(f"{f.rule} {f.location()} {f.symbol}: {f.message}"
+                     for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# The gate: this tree must lint clean against the committed baseline
+
+
+def test_tier_a_clean_against_baseline():
+    """Any unbaselined Tier-A finding (host-sync, wiring, inventory
+    drift, lock discipline, determinism) fails tier-1 right here."""
+    findings = run_rules(AnalysisContext(), TIER_A)
+    new, stale = diff_against_baseline(findings)
+    assert not new, f"unbaselined findings:\n{_fmt(new)}"
+    assert not stale, f"stale baseline entries (fixed? shrink the " \
+                      f"baseline): {stale}"
+
+
+def test_baseline_entries_carry_reviewed_reasons():
+    """The committed baseline may hold only justified exceptions."""
+    for e in load_baseline():
+        assert e.get("reason") and "TODO" not in e["reason"], (
+            f"baseline entry without a reviewed reason: {e}")
+
+
+def test_tier_b_clean_on_tiny_q5():
+    """Exercise a tiny Q5-shaped pipeline and audit every compiled
+    program it registered: scatter on the fire path, f64 leaks, missing
+    donation, and value-derived cache keys must all be absent (or
+    baselined)."""
+    jax = pytest.importorskip("jax")
+    del jax
+    from flink_tpu.metrics.device import PROGRAM_AUDIT
+    from flink_tpu.analysis.jaxpr_rules import exercise_programs
+    if not PROGRAM_AUDIT:
+        exercise_programs()
+    skipped: list = []
+    findings = run_rules(AnalysisContext(), TIER_B, skipped)
+    assert not skipped, f"tier-B rules skipped: {skipped}"
+    new, _stale = diff_against_baseline(findings)
+    assert not new, f"unbaselined program findings:\n{_fmt(new)}"
+
+
+def test_cli_lint_exits_zero_on_committed_tree(capsys):
+    """Acceptance: `python -m flink_tpu.cli lint` (all rules) exits 0."""
+    pytest.importorskip("jax")
+    from flink_tpu.cli import main
+    rc = main(["lint"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"cli lint failed:\n{out}"
+    assert "0 new" in out
+
+
+def test_cli_lint_unknown_rule_is_usage_error(capsys):
+    from flink_tpu.cli import main
+    assert main(["lint", "--rules", "TPU999"]) == 2
+
+
+def test_cli_lint_json_shape(capsys):
+    from flink_tpu.cli import main
+    rc = main(["lint", "--rules", "TPU501", "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(data) == {"findings", "new", "stale_baseline", "skipped"}
+
+
+# ---------------------------------------------------------------------------
+# Seeded regressions: each rule fires on an injected violation
+
+
+def _mini_pkg(tmp_path, files: dict, **settings_overrides):
+    """Build a throwaway package tree and a context pointing at it."""
+    root = tmp_path / "repo"
+    pkg = root / "pkg"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(textwrap.dedent(src))
+    settings = AnalysisSettings(**settings_overrides)
+    return AnalysisContext(package_root=root, package_name="pkg",
+                           settings=settings, extra_files=())
+
+
+def test_seeded_host_sync_detected(tmp_path):
+    """An injected float(device_val) in a hot-path module is flagged
+    with rule TPU101 at the exact line; the same call under a reasoned
+    sync-ok annotation is not."""
+    ctx = _mini_pkg(tmp_path, {
+        "hot.py": """\
+            import jax
+
+            def bad(self):
+                return float(self._acc_dev)           # line 4
+
+            def also_bad(x_dev):
+                return x_dev.item()
+
+            def fine(x_dev):
+                # lint: sync-ok amortized once per fire
+                return float(x_dev)
+
+            def host_only(ts):
+                return int(ts.min())
+            """,
+    }, hot_path_modules=("hot.py",))
+    findings = run_rules(ctx, ["TPU101"])
+    assert [(f.rule, f.file, f.line) for f in findings] == [
+        ("TPU101", "pkg/hot.py", 4), ("TPU101", "pkg/hot.py", 7)]
+    assert "float()" in findings[0].message
+
+
+def test_seeded_missing_singleton_detected(tmp_path):
+    """A deploy entry point that wires FAULTS but never TRACER is
+    flagged with rule TPU201 naming the missing singleton — including
+    when the configure call hides one level down the call graph."""
+    ctx = _mini_pkg(tmp_path, {
+        "deploy.py": """\
+            from .wiring import wire_faults
+
+            def launch(config):
+                wire_faults(config)
+                return object()
+            """,
+        "wiring.py": """\
+            FAULTS = object()
+            TRACER = object()
+
+            def wire_faults(config):
+                FAULTS.configure(config)
+            """,
+    }, entry_points=(("deploy.py", "launch"),),
+       singletons=(("FAULTS", ("FAULTS",)), ("TRACER", ("TRACER",))))
+    findings = run_rules(ctx, ["TPU201"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert (f.rule, f.file, f.symbol) == (
+        "TPU201", "pkg/deploy.py", "launch:TRACER")
+    assert f.line == 3  # anchored at the entry point def
+
+
+def test_seeded_config_key_typo_detected(tmp_path):
+    """A literal that looks like a config key of a real family but is
+    not declared (a typo) is flagged with rule TPU304."""
+    ctx = _mini_pkg(tmp_path, {
+        "uses.py": """\
+            def f(config):
+                return config.get("checkpoint.intervall")  # typo, line 2
+            """,
+    })
+    findings = run_rules(ctx, ["TPU304"])
+    assert [(f.rule, f.file, f.line) for f in findings] == [
+        ("TPU304", "pkg/uses.py", 2)]
+    assert "checkpoint.intervall" in findings[0].message
+
+
+def test_seeded_unlocked_mutation_detected(tmp_path):
+    """A class that guards an attribute under self._lock in one method
+    but mutates it bare in another is flagged with rule TPU401."""
+    ctx = _mini_pkg(tmp_path, {
+        "locked.py": """\
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.total = 0
+
+                def add(self, n):
+                    with self._lock:
+                        self.total += n
+
+                def reset(self):
+                    self.total = 0                    # line 13: un-locked
+
+                def _drain_locked(self):
+                    self.total = 0                    # _locked convention
+            """,
+    })
+    findings = run_rules(ctx, ["TPU401"])
+    assert [(f.rule, f.file, f.line) for f in findings] == [
+        ("TPU401", "pkg/locked.py", 13)]
+    assert findings[0].symbol == "Counter.reset:total"
+
+
+def test_seeded_unguarded_global_detected(tmp_path):
+    ctx = _mini_pkg(tmp_path, {
+        "events.py": """\
+            EVENTS = []
+            GUARDED = []  # lint: guarded-by appended under EV_LOCK only
+
+            def record(e):
+                EVENTS.append(e)
+                GUARDED.append(e)
+
+            def clear():
+                EVENTS.clear()
+                GUARDED.clear()
+            """,
+    })
+    findings = run_rules(ctx, ["TPU402"])
+    assert [(f.rule, f.symbol, f.line) for f in findings] == [
+        ("TPU402", "EVENTS", 1)]
+
+
+def test_seeded_wall_clock_and_rng_detected(tmp_path):
+    ctx = _mini_pkg(tmp_path, {
+        "metrics/tracing.py": """\
+            import time
+
+            def stamp():
+                return time.time()                    # line 4
+            """,
+        "runtime/jitter.py": """\
+            import random
+
+            def backoff():
+                return random.random()                # line 4
+            """,
+    }, span_clock_modules=("metrics/tracing.py",),
+       runtime_rng_prefixes=("runtime/",))
+    clock = run_rules(ctx, ["TPU501"])
+    rng = run_rules(ctx, ["TPU502"])
+    assert [(f.rule, f.file, f.line) for f in clock] == [
+        ("TPU501", "pkg/metrics/tracing.py", 4)]
+    assert [(f.rule, f.file, f.line) for f in rng] == [
+        ("TPU502", "pkg/runtime/jitter.py", 4)]
+
+
+# ---------------------------------------------------------------------------
+# Seeded Tier-B regressions: scatter / f64 / donation / value-keyed
+
+
+@pytest.fixture
+def _audit_registry():
+    """Snapshot + restore the process-global program-audit registry so
+    seeded entries never leak into the gate tests (and vice versa)."""
+    pytest.importorskip("jax")
+    from flink_tpu.metrics.device import PROGRAM_AUDIT
+    saved = list(PROGRAM_AUDIT)
+    PROGRAM_AUDIT.clear()
+    yield PROGRAM_AUDIT
+    PROGRAM_AUDIT[:] = saved
+
+
+def _seed_program(registry, scope, fn, *abstract_args, build_key="k"):
+    from flink_tpu.metrics.device import ProgramAuditEntry
+    registry.append(ProgramAuditEntry(
+        scope, fn, tuple(abstract_args), {}, build_key,
+        ("/nowhere/seeded.py", 1)))
+
+
+def test_seeded_scatter_and_f64_programs_detected(_audit_registry):
+    """A scatter-bearing fire-path program and an f64-carrying program
+    are each detected with the right rule id (JX501 / JX502)."""
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_enable_x64", True)
+
+    scatterer = jax.jit(lambda x, i: x.at[i].add(1.0))
+    _seed_program(_audit_registry, "seeded.fire", scatterer,
+                  jax.ShapeDtypeStruct((128,), jnp.float32),
+                  jax.ShapeDtypeStruct((8,), jnp.int32))
+    doubler64 = jax.jit(lambda x: x * 2)
+    _seed_program(_audit_registry, "seeded.step", doubler64,
+                  jax.ShapeDtypeStruct((16,), jnp.float64))
+
+    scatter = run_rules(AnalysisContext(), ["JX501"])
+    f64 = run_rules(AnalysisContext(), ["JX502"])
+    assert [(f.rule, f.symbol.split(":")[0]) for f in scatter] == [
+        ("JX501", "seeded.fire")]
+    assert "scatter" in scatter[0].symbol
+    assert [(f.rule, f.symbol) for f in f64] == [
+        ("JX502", "seeded.step:float64")]
+
+
+def test_seeded_undonated_large_output_detected(_audit_registry):
+    import jax
+    import jax.numpy as jnp
+
+    grow = jax.jit(lambda state, d: (state + d, state.sum()))
+    big = jax.ShapeDtypeStruct((1024, 1024), jnp.float32)  # 4 MiB
+    _seed_program(_audit_registry, "seeded.step", grow, big, big)
+    findings = run_rules(AnalysisContext(), ["JX503"])
+    assert [(f.rule, f.symbol) for f in findings] == [
+        ("JX503", "seeded.step:no-donation")]
+
+    # the donated twin is clean
+    _audit_registry.clear()
+    donated = jax.jit(lambda state, d: (state + d, state.sum()),
+                      donate_argnums=(0,))
+    _seed_program(_audit_registry, "seeded.step", donated, big, big)
+    assert run_rules(AnalysisContext(), ["JX503"]) == []
+
+
+def test_seeded_value_keyed_cache_detected(_audit_registry):
+    """Two builds of one scope with identical array signatures but
+    different builder keys = a cache key derived from values (JX504)."""
+    import jax
+    import jax.numpy as jnp
+
+    sds = jax.ShapeDtypeStruct((64,), jnp.float32)
+    for key in ("boundary=1000", "boundary=2000"):
+        _seed_program(_audit_registry, "seeded.step",
+                      jax.jit(lambda x: x + 1), sds, build_key=key)
+    findings = run_rules(AnalysisContext(), ["JX504"])
+    assert [(f.rule, f.symbol) for f in findings] == [
+        ("JX504", "seeded.step:value-keyed")]
+
+
+# ---------------------------------------------------------------------------
+# Framework mechanics: fingerprints, baseline diff, suppression hygiene
+
+
+def test_fingerprint_survives_line_shifts():
+    a = Finding(rule="TPU101", file="pkg/hot.py", line=10, symbol="f:x",
+                message="m")
+    b = Finding(rule="TPU101", file="pkg/hot.py", line=99, symbol="f:x",
+                message="m")
+    assert a.fingerprint == b.fingerprint
+    assert a.fingerprint != Finding(rule="TPU102", file="pkg/hot.py",
+                                    line=10, symbol="f:x",
+                                    message="m").fingerprint
+
+
+def test_baseline_diff_reports_new_and_stale():
+    f_known = Finding(rule="R", file="a.py", line=1, symbol="s1",
+                      message="m")
+    f_new = Finding(rule="R", file="a.py", line=2, symbol="s2",
+                    message="m")
+    baseline = [
+        {"rule": "R", "file": "a.py", "symbol": "s1",
+         "fingerprint": f_known.fingerprint, "reason": "ok"},
+        {"rule": "R", "file": "gone.py", "symbol": "dead",
+         "fingerprint": "feedfeedfeedfeed", "reason": "ok"},
+    ]
+    new, stale = diff_against_baseline([f_known, f_new], baseline)
+    assert [f.symbol for f in new] == ["s2"]
+    assert [e["symbol"] for e in stale] == ["dead"]
+
+
+def test_suppression_without_reason_does_not_suppress(tmp_path):
+    """`# lint: sync-ok` with no reason is not a suppression — the
+    reason is the reviewable record."""
+    ctx = _mini_pkg(tmp_path, {
+        "hot.py": """\
+            def bad(x_dev):
+                # lint: sync-ok
+                return float(x_dev)
+            """,
+    }, hot_path_modules=("hot.py",))
+    findings = run_rules(ctx, ["TPU101"])
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_every_registered_rule_has_catalogue_entry():
+    """docs/ANALYSIS.md documents every rule id (and no phantom ids)."""
+    import pathlib
+    doc = (pathlib.Path(__file__).parent.parent / "docs" /
+           "ANALYSIS.md").read_text()
+    for rule_id in all_rules():
+        assert f"`{rule_id}`" in doc, f"{rule_id} missing from " \
+                                      "docs/ANALYSIS.md"

@@ -165,34 +165,5 @@ class TestWatermarks:
         assert list(b2.timestamps) == [5, 9]
 
 
-def test_config_docs_generator_covers_all_options():
-    """Docs generate from the option definitions (reference
-    ConfigOptionsDocGenerator): every ConfigOption in every *Options class
-    appears exactly once."""
-    import inspect
-
-    from flink_tpu.core import config as cfg
-    from flink_tpu.core.config import ConfigOption
-    from flink_tpu.docs import generate_config_docs
-
-    text = generate_config_docs()
-    for name, cls in inspect.getmembers(cfg, inspect.isclass):
-        if not name.endswith("Options"):
-            continue
-        for attr, val in vars(cls).items():
-            if isinstance(val, ConfigOption):
-                assert text.count(f"| `{val.key}` |") == 1, val.key
-
-
-def test_committed_config_docs_are_fresh():
-    """The committed docs/CONFIG.md must equal the generator output — a
-    ConfigOption change without rerunning `python -m flink_tpu.docs`
-    fails here (the actual 'docs cannot drift' enforcement)."""
-    import os
-
-    from flink_tpu.docs import generate_config_docs
-
-    path = os.path.join(os.path.dirname(__file__), "..", "docs",
-                        "CONFIG.md")
-    with open(path) as f:
-        assert f.read() == generate_config_docs()
+# (config-docs doc-lock moved onto the tpu-lint framework: rule TPU303
+# in flink_tpu/analysis/inventory.py, exercised by tests/test_analysis.py)

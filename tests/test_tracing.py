@@ -7,8 +7,6 @@ docs/OBSERVABILITY.md's span table from rotting."""
 
 import json
 import os
-import pathlib
-import re
 import threading
 import time
 import urllib.error
@@ -18,7 +16,6 @@ from types import SimpleNamespace
 import numpy as np
 import pytest
 
-import flink_tpu
 from flink_tpu.api.environment import StreamExecutionEnvironment
 from flink_tpu.connectors.core import CollectSink
 from flink_tpu.core.config import (
@@ -27,7 +24,7 @@ from flink_tpu.core.config import (
 from flink_tpu.core.records import RecordBatch, Schema
 from flink_tpu.metrics.device import DEVICE_STATS
 from flink_tpu.metrics.tracing import (
-    FLIGHT_RECORDER, InMemoryTraceReporter, SPAN_INVENTORY, TRACER,
+    FLIGHT_RECORDER, InMemoryTraceReporter, TRACER,
     TraceContext, Tracer, chrome_trace_events, current_context, use_context,
 )
 from flink_tpu.runtime import faults as faults_mod
@@ -577,29 +574,5 @@ def test_rest_traces_endpoint_and_cli_trace_dump(tmp_path, capsys):
 
 
 # -- doc-code consistency ----------------------------------------------------
-
-def test_span_inventory_matches_code_and_docs():
-    """Satellite: the (scope, name) pairs emitted by the runtime, the
-    SPAN_INVENTORY constant, and the docs/OBSERVABILITY.md table must be
-    identical — a new span site without a doc row fails here."""
-    pkg = pathlib.Path(flink_tpu.__file__).parent
-    pat = re.compile(r'\.span\(\s*"(\w+)",\s*"(\w+)"')
-    code_pairs = set()
-    for p in pkg.rglob("*.py"):
-        code_pairs.update(pat.findall(p.read_text()))
-    inv_pairs = {(scope, name) for scope, name, _ in SPAN_INVENTORY}
-    assert code_pairs == inv_pairs, (
-        f"code-only: {sorted(code_pairs - inv_pairs)}; "
-        f"inventory-only: {sorted(inv_pairs - code_pairs)}")
-    doc = (pkg.parent / "docs" / "OBSERVABILITY.md").read_text()
-    doc_pairs = set(re.findall(r"^\| `(\w+)` \| `(\w+)` \|", doc, re.M))
-    assert doc_pairs == inv_pairs, (
-        f"doc-only: {sorted(doc_pairs - inv_pairs)}; "
-        f"undocumented: {sorted(inv_pairs - doc_pairs)}")
-    # the inventory stays sorted so diffs are mechanical
-    assert list(SPAN_INVENTORY) == sorted(
-        SPAN_INVENTORY, key=lambda e: (e[0], e[1]))
-    # every emitting site names a real file
-    for _, _, where in SPAN_INVENTORY:
-        rel = where.split(" ")[0]
-        assert (pkg / rel).is_file(), f"inventory cites missing {rel}"
+# (span-inventory doc-lock moved onto the tpu-lint framework: rule TPU301
+# in flink_tpu/analysis/inventory.py, exercised by tests/test_analysis.py)
