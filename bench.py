@@ -225,7 +225,7 @@ def _collect_metrics(env, before: dict) -> dict:
     # only under injection or a genuinely failing/hanging device path
     # incremental fire engine + coalesced ingest counters (deltas)
     for k in ("panes_sealed_total", "batches_coalesced_total",
-              "fire_merge_rows_read"):
+              "fire_merge_rows_read", "chain_fused_dispatches_total"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
     for k in ("device_retries_total", "device_degraded_total",
               "dead_letter_records_total", "injected_faults_total",
@@ -1048,23 +1048,29 @@ def _maybe_write_trace(stage: str) -> None:
 
 def _audit_report() -> dict:
     """tpu-lint Tier-B jaxpr audit over every compiled program the run
-    just registered (metrics.device PROGRAM_AUDIT): per-rule finding
-    counts plus the count not covered by the committed baseline.  The
-    tiny Q5 report must show audit_new == 0 — a scatter on the fire
-    path or an f64 leak fails the acceptance probe, not a code review."""
+    just registered (metrics.device PROGRAM_AUDIT) plus the Tier-P
+    fusion-certificate audit over every chain the run certified
+    (graph.fusion CERTIFICATE_LOG): per-rule finding counts plus the
+    count not covered by the committed baseline.  The tiny Q5 report
+    must show audit_new == 0 — a scatter on the fire path, an f64 leak,
+    or a rejected fusion boundary fails the acceptance probe, not a
+    code review."""
     from flink_tpu.analysis import (AnalysisContext, all_rules,
                                     diff_against_baseline, run_rules)
+    from flink_tpu.graph.fusion import CERTIFICATE_LOG
     from flink_tpu.metrics.device import PROGRAM_AUDIT
 
-    tier_b = sorted(r for r, rr in all_rules().items() if rr.tier == "B")
+    audited = sorted(r for r, rr in all_rules().items()
+                     if rr.tier in ("B", "P"))
     skipped: list = []
-    findings = run_rules(AnalysisContext(), tier_b, skipped)
+    findings = run_rules(AnalysisContext(), audited, skipped)
     new, _stale = diff_against_baseline(findings)
-    counts = {r: 0 for r in tier_b}
+    counts = {r: 0 for r in audited}
     for f in findings:
         counts[f.rule] += 1
     report = {f"audit_{r}": n for r, n in counts.items()}
     report["audit_programs"] = len(PROGRAM_AUDIT)
+    report["audit_certificates"] = len(CERTIFICATE_LOG)
     report["audit_new"] = len(new)
     if skipped:
         report["audit_skipped"] = skipped
@@ -1093,6 +1099,92 @@ def tiny(fire_mode: str = "full", window_panes_list=(5,),
             rec.update(_audit_report())
         print(json.dumps(rec))
     _maybe_write_trace("tiny_q5")
+    sys.stdout.flush()
+
+
+#: The --fused stage's generator is MODULE-LEVEL on purpose: the fused
+#: chain's program cache (runtime/compiled._PROGRAM_CACHE) keys on the
+#: gen function object, so warmup and timed runs share one compiled
+#: chain exactly as a long-running job would — a closure per run
+#: (what _run_q5 builds) would recompile the chain every execute().
+_FUSED_KEYS = 257
+_FUSED_SPAN = 8000
+
+
+def _fused_gen(idx):
+    u = idx.astype(np.uint64)
+    auction = ((u * np.uint64(MULT)) % np.uint64(_FUSED_KEYS)) \
+        .astype(np.int64)
+    return {"auction": auction, "price": (idx % 997) + 1,
+            "ts": (idx * _FUSED_SPAN) // (1 << 15)}
+
+
+def _run_fused_stage(fusion_on: bool, batch: int, n_events: int):
+    """One execute() of the ingest-isolating Q5 variant: count-only
+    aggregate, a handful of panes (fires are rare — the fire path is
+    identical fused/unfused, so the stage measures what fusion changes:
+    per-micro-batch ingest dispatches). Returns (wall, rows, stages)."""
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core import WatermarkStrategy
+    from flink_tpu.core.config import PipelineOptions
+    from flink_tpu.core.records import Schema
+    from flink_tpu.metrics import DEVICE_STATS
+    from flink_tpu.runtime.operators.device_window import AggSpec
+    from flink_tpu.window import SlidingEventTimeWindows
+
+    schema = Schema([("auction", np.int64), ("price", np.int64),
+                     ("ts", np.int64)])
+    stats_before = DEVICE_STATS.snapshot()
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, batch)
+    env.config.set(PipelineOptions.FUSION, fusion_on)
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _CountSink()
+    (env.datagen(_fused_gen, schema, count=n_events, timestamp_column="ts",
+                 watermark_strategy=ws, device=True)
+        .key_by("auction")
+        .window(SlidingEventTimeWindows.of(10_000, 2000))
+        .device_aggregate([AggSpec("count", out_name="bids",
+                                   value_bits=31)],
+                          capacity=1 << 12, ring_size=32,
+                          defer_overflow=True)
+        .add_sink(sink.fn, "count"))
+    t0 = time.perf_counter()
+    env.execute("nexmark-q5-fused", timeout=1800.0)
+    wall = time.perf_counter() - t0
+    stages = _collect_metrics(env, stats_before)
+    return wall, sink.rows, stages
+
+
+def fused(batch: int = 64, n_batches: int = 512) -> None:
+    """`python bench.py --fused [--audit]`: the fusion-certifier
+    acceptance stage — the same device-source -> window pipeline run
+    twice at a small micro-batch size (the dispatch-overhead regime the
+    fused chain targets), once unfused and once with
+    `pipeline.fusion.enabled`, each after a compile warmup. One JSON
+    line with both runs inline plus the speedup ratio. The fused timed
+    run must show `recompiles == 0` and exactly one
+    `chain_fused_dispatches_total` per micro-batch."""
+    probe = _ensure_backend()
+    _emit_probe(probe)
+    n_events = n_batches * batch
+    rec = {"metric": "nexmark_q5_fused_report", "unit": "report",
+           "batch": batch, "n_events": n_events}
+    for label, on in (("unfused", False), ("fused", True)):
+        _run_fused_stage(on, batch, 4 * batch)              # compile warmup
+        wall, rows, stages = _run_fused_stage(on, batch, n_events)
+        rec[f"{label}_events_per_sec"] = round(n_events / wall, 2)
+        rec[f"{label}_recompiles"] = stages["recompiles"]
+        rec[f"{label}_chain_dispatches"] = stages[
+            "chain_fused_dispatches_total"]
+        rec[f"{label}_emitted_rows"] = rows
+    rec["fused_speedup"] = round(rec["fused_events_per_sec"]
+                                 / rec["unfused_events_per_sec"], 3)
+    if "--audit" in sys.argv:
+        rec.update(_audit_report())
+    print(json.dumps(rec))
     sys.stdout.flush()
 
 
@@ -1160,6 +1252,8 @@ if __name__ == "__main__":
     elif "--tiny" in sys.argv:
         tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,
              audit="--audit" in sys.argv)
+    elif "--fused" in sys.argv:
+        fused()
     elif "--audit" in sys.argv:
         # audit alone: the tiny acceptance probe with the jaxpr audit on
         tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,

@@ -36,3 +36,4 @@ from .core import (  # noqa: F401
 from . import ast_rules  # noqa: F401,E402
 from . import inventory  # noqa: F401,E402
 from . import jaxpr_rules  # noqa: F401,E402
+from . import plan_rules  # noqa: F401,E402

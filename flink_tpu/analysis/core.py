@@ -88,7 +88,7 @@ def rule(id: str, title: str, tier: str, description: str):
 def all_rules() -> Dict[str, Rule]:
     # Ensure the rule modules have been imported (registration side
     # effect) even when core is imported directly.
-    from . import ast_rules, inventory, jaxpr_rules  # noqa: F401
+    from . import ast_rules, inventory, jaxpr_rules, plan_rules  # noqa: F401
     return dict(_RULES)
 
 
@@ -289,11 +289,13 @@ def load_baseline(path: Optional[Path] = None) -> List[dict]:
 
 def save_baseline(findings: Sequence[Finding],
                   path: Optional[Path] = None,
-                  previous: Optional[List[dict]] = None) -> None:
+                  previous: Optional[List[dict]] = None,
+                  default_reason: Optional[str] = None) -> None:
     """Write the baseline for ``findings``; reasons from a previous
-    baseline are preserved by fingerprint, new entries get a TODO reason
-    that a reviewer must replace (the committed baseline holds only
-    justified exceptions)."""
+    baseline are preserved by fingerprint, new entries get
+    ``default_reason`` (the CLI's ``--reason``) or a TODO reason that a
+    reviewer must replace (the committed baseline holds only justified
+    exceptions — BASE601 flags entries still carrying the TODO)."""
     path = path or baseline_path()
     prev = {e["fingerprint"]: e for e in (previous
                                           if previous is not None
@@ -305,7 +307,8 @@ def save_baseline(findings: Sequence[Finding],
             "rule": f.rule, "file": f.file, "symbol": f.symbol,
             "fingerprint": f.fingerprint,
             "reason": (old or {}).get(
-                "reason", "TODO: justify this exception or fix it"),
+                "reason",
+                default_reason or "TODO: justify this exception or fix it"),
         })
     path.write_text(json.dumps({"version": 1, "entries": entries},
                                indent=2, sort_keys=True) + "\n")

@@ -292,6 +292,128 @@ def recompile_hazard_rule(ctx: AnalysisContext) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# JX6xx — fused-chain program audit (the fusion certifier's runtime half:
+# graph/fusion.py certifies the plan, these rules lock the programs the
+# lowering actually built; scopes are "chain.fused_prelude" — the
+# source-decode + pure stages — and "chain.fused_step" — prelude + the
+# donated window step, the one dispatch per micro-batch)
+
+
+_CHAIN_PRELUDE_SCOPE = "chain.fused_prelude"
+_CHAIN_STEP_SCOPE = "chain.fused_step"
+
+
+def _chain_entries(prefix: str):
+    entries = [e for e in _entries() if e.scope.startswith(prefix)]
+    if not entries:
+        skip_rule(f"no '{prefix}' programs registered — run a fused "
+                  "pipeline (pipeline.fusion.enabled) first")
+    return entries
+
+
+@rule("JX601", "fused chain prelude must be scatter-free", "B",
+      "the certified source-decode -> filter/map stages of a fused "
+      "chain run once per micro-batch ahead of the window step; a "
+      "scatter there lowers to a serial loop on the CPU rung and "
+      "forfeits the fusion win (the window fold's own scatters are "
+      "governed separately by the fire-path rule)")
+def chain_scatter_rule(ctx: AnalysisContext) -> List[Finding]:
+    jax = _require_jax()
+    findings: List[Finding] = []
+    for entry in _chain_entries(_CHAIN_PRELUDE_SCOPE):
+        closed = _trace_jaxpr(jax, entry)
+        if closed is None:
+            continue
+        prims = sorted({eqn.primitive.name
+                        for eqn in _iter_eqns(closed.jaxpr)
+                        if eqn.primitive.name.startswith("scatter")})
+        if not prims:
+            continue
+        file, line = _entry_location(ctx, entry)
+        findings.append(Finding(
+            rule="JX601", file=file, line=line,
+            symbol=f"{entry.scope}:{'+'.join(prims)}",
+            message=f"fused chain prelude '{entry.scope}' lowers "
+                    f"{', '.join(prims)}",
+            hint="express the stage with gathers/masks/segment ops; a "
+                 "stage that genuinely needs scatter is not certifiable "
+                 "as part of the prelude"))
+    return findings
+
+
+@rule("JX602", "donation must thread through the fused chain", "B",
+      "the fused step consumes-and-replaces the window state planes; "
+      "without input_output_alias every micro-batch allocates a fresh "
+      "copy of the whole table, so donation is mandatory for chain "
+      "step programs regardless of size")
+def chain_donation_rule(ctx: AnalysisContext) -> List[Finding]:
+    _require_jax()
+    findings: List[Finding] = []
+    for entry in _chain_entries(_CHAIN_STEP_SCOPE):
+        lower = getattr(entry.fn, "lower", None)
+        text = ""
+        if lower is not None:
+            try:
+                text = lower(*entry.abstract_args,
+                             **entry.abstract_kwargs).as_text()
+            except Exception:
+                continue
+        if "input_output_alias" in text or "aliasing_output" in text:
+            continue
+        file, line = _entry_location(ctx, entry)
+        findings.append(Finding(
+            rule="JX602", file=file, line=line,
+            symbol=f"{entry.scope}:no-donation",
+            message=f"fused chain step '{entry.scope}' declares no "
+                    "buffer donation: state planes are copied every "
+                    "micro-batch",
+            hint="thread donate_argnums through the composed program for "
+                 "the table and every accumulator plane"))
+    return findings
+
+
+@rule("JX603", "fused chain cache key must be shape-only", "B",
+      "a fused chain program is rebuilt per (shapes, dtypes) bucket "
+      "only; any value or identity (closure id, start index, batch "
+      "number) in the cache key means a recompile per micro-batch — "
+      "the exact failure the certifier exists to prevent")
+def chain_cache_key_rule(ctx: AnalysisContext) -> List[Finding]:
+    jax = _require_jax()
+    findings: List[Finding] = []
+    entries = _chain_entries("chain.")
+    for entry in entries:
+        expected = _array_signature(jax, entry)
+        if entry.build_key == expected:
+            continue
+        file, line = _entry_location(ctx, entry)
+        findings.append(Finding(
+            rule="JX603", file=file, line=line,
+            symbol=f"{entry.scope}:value-keyed",
+            message=f"chain program '{entry.scope}' build key "
+                    f"{entry.build_key!r} is not the canonical "
+                    "shape/dtype signature of its dispatch",
+            hint="derive the key with runtime.compiled.shape_key(...) "
+                 "from the traced arguments only"))
+    by_scope_sig: Dict[Tuple[str, str], list] = {}
+    for entry in entries:
+        by_scope_sig.setdefault(
+            (entry.scope, _array_signature(jax, entry)), []).append(entry)
+    for (scope, _sig), group in sorted(by_scope_sig.items()):
+        if len(group) < 2 or len({e.build_key for e in group}) < 2:
+            continue
+        file, line = _entry_location(ctx, group[0])
+        findings.append(Finding(
+            rule="JX603", file=file, line=line,
+            symbol=f"{scope}:key-collision",
+            message=f"chain scope '{scope}' compiled {len(group)} "
+                    "programs with identical array signatures but "
+                    "different build keys",
+            hint="derive the key with runtime.compiled.shape_key(...) "
+                 "from the traced arguments only"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # Exercise: populate PROGRAM_AUDIT with a tiny Q5-shaped pipeline
 
 
@@ -333,13 +455,17 @@ def exercise_programs(n_events: int = 4096, batch: int = 1024,
         def invoke_batch(self, batch):
             return True
 
-    # (fire_mode, device_ingest): device ingest exercises the coalesced
-    # native_fold program, host ingest the per-batch step program.
-    runs = [(m, True) for m in fire_modes] + [(fire_modes[0], False)]
-    for fire_mode, device_ingest in runs:
+    # (fire_mode, device_ingest, fused): device ingest exercises the
+    # coalesced native_fold program, host ingest the per-batch step
+    # program, and the fused run registers the certified chain programs
+    # (chain.fused_prelude / chain.fused_step) for JX601-603.
+    runs = ([(m, True, False) for m in fire_modes]
+            + [(fire_modes[0], False, False), (fire_modes[0], True, True)])
+    for fire_mode, device_ingest, fused in runs:
         env = StreamExecutionEnvironment.get_execution_environment()
         env.set_state_backend("tpu")
         env.config.set(PipelineOptions.BATCH_SIZE, batch)
+        env.config.set(PipelineOptions.FUSION, fused)
         env.config.set("window.fire.incremental",
                        fire_mode == "incremental")
         ws = WatermarkStrategy.for_monotonous_timestamps() \

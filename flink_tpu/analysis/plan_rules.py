@@ -1,0 +1,137 @@
+"""Tier-P (plan) rules: graph-level fusion findings from the fusion
+certifier, plus the baseline-hygiene rule.
+
+The certifier (graph/fusion.py) does the actual analysis when a job
+graph is compiled; these rules surface its rejected-boundary findings
+through the tpu-lint gate so an example or test pipeline that SHOULD
+fuse — but is cut by a host-effectful op, a serializer boundary, a
+shuffle, or a timer escape — fails ``pytest -m lint`` against the
+committed baseline like any other regression.
+
+Certificates come from ``fusion.CERTIFICATE_LOG`` (populated by every
+``certify()`` call in-process — tests seed it directly); when the log
+is empty the rules certify every pipeline under ``examples/`` through
+the capture harness, mirroring how Tier B exercises device programs.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .core import AnalysisContext, Finding, load_baseline, rule, skip_rule
+
+__all__ = ["plan_rule_ids"]
+
+_PLAN_RULES = ("PLAN601", "PLAN602", "PLAN603", "PLAN604")
+
+
+def plan_rule_ids() -> tuple:
+    return _PLAN_RULES
+
+
+def _certificates(ctx: AnalysisContext) -> list:
+    cached = getattr(ctx, "_plan_certificates", None)
+    if cached is not None:
+        return cached
+    try:
+        from ..graph.fusion import CERTIFICATE_LOG, exercise_certificates
+    except Exception as e:  # pragma: no cover - broken runtime import
+        skip_rule(f"fusion certifier unavailable: {e!r}")
+    certs = list(CERTIFICATE_LOG)
+    if not certs:
+        try:
+            certs = exercise_certificates(ctx.root / "examples")
+        except Exception as e:
+            skip_rule(f"could not exercise example pipelines: {e!r}")
+    if not certs:
+        skip_rule("no fusion certificates captured "
+                  "(no pipelines compiled, no examples/ found)")
+    ctx._plan_certificates = certs
+    return certs
+
+
+def _plan_findings(ctx: AnalysisContext, rule_id: str,
+                   hint: str) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()
+    for cert in _certificates(ctx):
+        for f in cert.findings():
+            if f.rule != rule_id:
+                continue
+            key = (f.file, f.line, f.symbol, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if f.file != "<unknown>" and f.line and \
+                    (ctx.root / f.file).is_file() and \
+                    ctx.suppression(f.file, f.line, rule_id.lower()):
+                continue
+            out.append(Finding(rule=rule_id, file=f.file, line=f.line,
+                               symbol=f.symbol, message=f.message,
+                               hint=hint))
+    return out
+
+
+@rule("PLAN601", "host-effectful op cuts a fusable chain", "P",
+      "An opaque/host-effectful operator interrupts a run of "
+      "device-fusable operators: every record pays a device round-trip "
+      "plus a dispatch at the boundary.")
+def plan601_host_effectful(ctx: AnalysisContext) -> List[Finding]:
+    return _plan_findings(
+        ctx, "PLAN601",
+        "make the op jax-traceable (BatchFn(traceable=True) / a "
+        "vectorized *_batch method) or move it past the flush point")
+
+
+@rule("PLAN602", "serializer/schema boundary cuts a fusable chain", "P",
+      "A row-loop operator decodes host rows in the middle of a "
+      "device-fusable run — a serialize/deserialize boundary that "
+      "forces device->host materialization per batch.")
+def plan602_serializer(ctx: AnalysisContext) -> List[Finding]:
+    return _plan_findings(
+        ctx, "PLAN602",
+        "implement map_batch/filter_batch so the op stays columnar, or "
+        "hoist the row logic behind the keyed flush point")
+
+
+@rule("PLAN603", "shuffle where fusion was possible", "P",
+      "A non-forward (or feedback) exchange separates two fusable "
+      "operators at equal parallelism: the shuffle costs a dispatch + "
+      "partition round-trip a forward edge would not.")
+def plan603_shuffle(ctx: AnalysisContext) -> List[Finding]:
+    return _plan_findings(
+        ctx, "PLAN603",
+        "drop the rebalance/rescale between pure operators (forward "
+        "edges chain) or move the keyed exchange to the stateful op")
+
+
+@rule("PLAN604", "timer/side-output escape cuts a fusable chain", "P",
+      "A timer-driven operator or a side-output tag escapes the "
+      "candidate fused region: records/timers leave mid-dispatch, so "
+      "the chain cannot lower to one program across it.")
+def plan604_escape(ctx: AnalysisContext) -> List[Finding]:
+    return _plan_findings(
+        ctx, "PLAN604",
+        "timers and side outputs are legal only at chain flush points; "
+        "split the chain there or fold the logic into the window step")
+
+
+@rule("BASE601", "baseline entry still carries the TODO reason", "A",
+      "Every committed baseline entry must carry a reviewed reason; "
+      "'TODO: justify this exception or fix it' is the placeholder "
+      "--update-baseline stamps when --reason was not given.")
+def base601_todo_reason(ctx: AnalysisContext) -> List[Finding]:
+    out: List[Finding] = []
+    for e in load_baseline():
+        reason = (e.get("reason") or "").strip()
+        if not reason or reason.startswith("TODO"):
+            out.append(Finding(
+                rule="BASE601",
+                file="flink_tpu/analysis/baseline.json", line=0,
+                symbol=e.get("fingerprint", "?"),
+                message=(f"baseline entry {e.get('rule')} @ "
+                         f"{e.get('file')}:{e.get('symbol')} has no "
+                         f"reviewed reason (got {reason!r})"),
+                hint="re-run cli lint --update-baseline --reason '<why "
+                     "this exception is sound>' or fix the finding"))
+    return out

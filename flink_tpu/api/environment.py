@@ -153,7 +153,12 @@ class StreamExecutionEnvironment:
 
     def get_job_graph(self, name: str = "job") -> JobGraph:
         self.config.set(PipelineOptions.NAME, name)
-        return build_job_graph(self.get_stream_graph(), self.config, name)
+        sg = self.get_stream_graph()
+        jg = build_job_graph(sg, self.config, name)
+        if self.config.get(PipelineOptions.FUSION):
+            from ..graph.fusion import certify
+            jg.certificate = certify(sg, jg, self.config)
+        return jg
 
     def set_remote_target(self, address: Optional[str]) -> None:
         """Route execute() to a running session cluster's Dispatcher at

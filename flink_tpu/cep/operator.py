@@ -43,6 +43,7 @@ class CepOperator(OneInputOperator):
         self._seq = itertools.count()
         # kg -> key -> {"buffer": [Event], "partials": [_Partial]}
         self._state: dict[int, dict[Any, dict]] = {}
+        self._late_dropped = 0
 
     def _key_state(self, key) -> dict:
         kg = assign_to_key_group(key, self.ctx.max_parallelism)
@@ -57,12 +58,29 @@ class CepOperator(OneInputOperator):
         cols = [batch.column(n) for n in names]
         keys = batch.column(self.key_column)
         ts_arr = batch.timestamps
+        # late events (behind the watermark their key already fired past)
+        # quarantine to the dead-letter side output, like the window
+        # operators' late_dropped path — never silently vanish
+        late = np.asarray(ts_arr) <= self.current_watermark
+        if late.any():
+            from ..metrics import DEVICE_STATS
+            n_late = int(late.sum())
+            self._late_dropped += n_late
+            DEVICE_STATS.note_dead_letter(n_late)
+            try:
+                self.output.emit_side("dead-letter", batch.filter(late))
+            except NotImplementedError:
+                pass  # no dead-letter consumer wired: counted, then dropped
         for i in range(batch.n):
+            if late[i]:
+                continue
             data = {n: _scalar(c[i]) for n, c in zip(names, cols)}
             ev = Event(next(self._seq), int(ts_arr[i]), data)
-            if ev.ts <= self.current_watermark:
-                continue  # late event: dropped (reference side-output TODO)
             self._key_state(_scalar(keys[i]))["buffer"].append(ev)
+
+    @property
+    def late_dropped(self) -> int:
+        return self._late_dropped
 
     def process_watermark(self, watermark) -> None:
         self._fire_up_to(watermark.timestamp)

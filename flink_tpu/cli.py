@@ -379,6 +379,50 @@ def _cmd_cluster(args) -> int:
         return 0
 
 
+def _cmd_plan(args) -> int:
+    """Print the fusion certificate for a pipeline script or .sql file:
+    per chained vertex the operator chain, its verdict (CERTIFIED /
+    PARTIAL / REJECTED), whether the runtime lowers the prefix to one
+    dispatch, and every rejecting PLAN6xx finding with file:line (the
+    catalogue lives in docs/ANALYSIS.md). Execution is stubbed — the
+    script's graphs compile and certify but never run."""
+    import json as _json
+
+    from .graph.fusion import capture_certificates
+
+    certs, err = capture_certificates(args.script, argv=args.args)
+    if err:
+        print(f"plan: script error after capture: {err}", file=sys.stderr)
+    if not certs:
+        print("plan: the script built no pipeline (nothing to certify)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(_json.dumps([c.to_dict() for c in certs], indent=2,
+                          sort_keys=True))
+        return 0
+    for cert in certs:
+        print(f"job {cert.job_name!r} "
+              f"fusion_enabled={cert.fusion_enabled}")
+        rows = []
+        for ch in cert.chains:
+            ops = " -> ".join(f"{o.name}[{o.category}]" for o in ch.ops)
+            lowered = "one-dispatch" if ch.lowered_prefix else "-"
+            if ch.findings:
+                rejects = "; ".join(f"{f.rule} {f.file}:{f.line}"
+                                    for f in ch.findings)
+            else:
+                rejects = "-"
+            rows.append([ch.vertex_id, ops, ch.verdict, lowered, rejects])
+        _print_table(["chain", "operators", "verdict", "lowered",
+                      "rejected by"], rows, max_rows=1000)
+        for ch in cert.chains:
+            for f in ch.findings:
+                print(f"  {f.rule} {f.file}:{f.line} [{f.symbol}] "
+                      f"{f.message}")
+    return 0
+
+
 def _cmd_lint(args) -> int:
     """tpu-lint driver: Tier-A AST rules + Tier-B jaxpr program audit,
     diffed against the committed baseline (flink_tpu/analysis/
@@ -418,9 +462,13 @@ def _cmd_lint(args) -> int:
     new, stale = diff_against_baseline(findings)
 
     if args.update_baseline:
-        save_baseline(findings)
-        print(f"baseline updated: {len(findings)} entries "
-              f"({len(new)} need a reviewed reason)")
+        save_baseline(findings, default_reason=args.reason or None)
+        if args.reason:
+            print(f"baseline updated: {len(findings)} entries "
+                  f"({len(new)} stamped with the given reason)")
+        else:
+            print(f"baseline updated: {len(findings)} entries "
+                  f"({len(new)} need a reviewed reason)")
         return 0
 
     if args.json:
@@ -552,7 +600,23 @@ def main(argv: Optional[list[str]] = None) -> int:
     lint.add_argument("--update-baseline", action="store_true",
                       help="rewrite flink_tpu/analysis/baseline.json "
                            "from the current findings")
+    lint.add_argument("--reason", default="",
+                      help="with --update-baseline: stamp NEW baseline "
+                           "entries with this reviewed reason instead of "
+                           "the TODO placeholder (BASE601 flags entries "
+                           "whose reason is still the TODO)")
     lint.set_defaults(fn=_cmd_lint)
+
+    plan = sub.add_parser(
+        "plan", help="print the fusion certificate for an example "
+                     "pipeline or .sql script (PLAN6xx rejections with "
+                     "file:line; see docs/ANALYSIS.md)")
+    plan.add_argument("script", help="a pipeline .py script or a .sql file")
+    plan.add_argument("--json", action="store_true",
+                      help="machine-readable certificate")
+    plan.add_argument("args", nargs="*",
+                      help="argv passed through to the script")
+    plan.set_defaults(fn=_cmd_plan)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=lambda a: (print("flink-tpu 0.1"), 0)[1])

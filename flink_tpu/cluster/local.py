@@ -342,9 +342,25 @@ def _deploy_vertices(job: "LocalJob", job_graph: JobGraph,
                 src_node = vertex.chained_nodes[0]
                 chain_ops = [n.operator_factory()
                              for n in vertex.chained_nodes[1:]]
+                reader = _make_reader(src_node, sub, vertex.parallelism)
+                # certified fused-chain lowering: the fusion certificate
+                # (graph/fusion.py) proved this vertex's source→window
+                # prefix collapses to one dispatch — arm both ends. Runtime
+                # gates (deferred overflow on the operator, a timestamp
+                # column on the reader) can still decline, in which case
+                # the chain runs exactly as before.
+                cert = getattr(job_graph, "certificate", None)
+                rep = (cert.chain_for_vertex(vid)
+                       if cert is not None else None)
+                if (rep is not None and rep.lowered_prefix and chain_ops
+                        and hasattr(reader, "enable_fused")
+                        and hasattr(chain_ops[0], "enable_fused_chain")
+                        and chain_ops[0].enable_fused_chain(
+                            src_node.source, sub, vertex.parallelism)):
+                    if not reader.enable_fused():
+                        chain_ops[0]._fused_spec = None
                 task = SourceStreamTask(
-                    task_id, ctx, src_node.source,
-                    _make_reader(src_node, sub, vertex.parallelism),
+                    task_id, ctx, src_node.source, reader,
                     src_node.watermark_strategy,
                     None, writers, job, config)
                 task.side_writers = side_writers

@@ -263,6 +263,37 @@ class _DeviceDataGenReader(_DataGenReader):
         self._viol = None                  # device monotonicity violation
         self._viol_checked = False
         self._prev_last = np.int64(MIN_TIMESTAMP)  # prior batch's tail ts
+        self._fused = False                # emit LazyDeviceBatch handles
+
+    # -- fused-chain mode --------------------------------------------------
+    def enable_fused(self) -> bool:
+        """Switch to fused-chain emission (certified lowering only): the
+        reader stops dispatching its decode program and emits
+        ``LazyDeviceBatch`` handles; the downstream chained window
+        operator runs decode+fold as ONE composed dispatch and hands the
+        monotonicity outputs back via ``_accept_monotonic``."""
+        if self._s._ts_col is None:
+            return False
+        self._fused = True
+        return True
+
+    def _accept_monotonic(self, viol, last) -> None:
+        """Receive (violation flag, tail timestamp) for a batch whose
+        decode ran downstream — same bookkeeping read_batch does in
+        unfused mode. Called exactly once per batch, in emission order
+        (the chain is in-task and synchronous)."""
+        self._viol = viol if self._viol is None else self._viol | viol
+        self._viol_checked = False
+        self._prev_last = last
+
+    def _realize_batch(self, n: int, start: int, prev_last):
+        """Unfused-fallback decode for one lazy batch (degraded mode,
+        validation screens, checkpoint capture): runs the ordinary
+        per-batch program with the batch's creation-time tail."""
+        dcols, viol, last = self._program(n)(np.int64(start), prev_last)
+        ts_col = self._s._ts_col
+        dts = dcols[ts_col].astype(np.int64) if ts_col is not None else None
+        return dcols, dts, viol, last
 
     def _program(self, n: int):
         prog = self._progs.get(n)
@@ -325,6 +356,26 @@ class _DeviceDataGenReader(_DataGenReader):
             n = 1 << (n.bit_length() - 1)   # power-of-two shape bucket
         first = self._next * self._parallelism + self._subtask
         last = (self._next + n - 1) * self._parallelism + self._subtask
+        if self._fused:
+            from ..core.device_records import LazyDeviceBatch
+
+            # endpoint event-time bounds on host (2-element gen_fn eval) —
+            # the only per-batch work in fused mode; the decode itself is
+            # composed into the window operator's single dispatch
+            ts_col = self._s._ts_col
+            ends = np.asarray(
+                self._s._gen(np.array([first, last], np.int64))[ts_col])
+            ts_min, ts_max = int(ends[0]), int(ends[1])
+            if ts_min > ts_max:
+                raise ValueError(
+                    "DataGenSource(device=True) needs a timestamp column "
+                    f"non-decreasing in the index; got ts({first})={ts_min} "
+                    f"> ts({last})={ts_max}")
+            batch = LazyDeviceBatch(self._s.schema, self, self._next, n,
+                                    self._prev_last, ts_min, ts_max,
+                                    ts_column=ts_col)
+            self._next += n
+            return batch
         dcols, viol, tail_ts = self._program(n)(np.int64(self._next),
                                                 self._prev_last)
         self._viol = viol if self._viol is None else self._viol | viol

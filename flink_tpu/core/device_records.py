@@ -26,7 +26,7 @@ import numpy as np
 
 from .records import RecordBatch, Schema
 
-__all__ = ["DeviceRecordBatch"]
+__all__ = ["DeviceRecordBatch", "LazyDeviceBatch"]
 
 
 class DeviceRecordBatch(RecordBatch):
@@ -95,3 +95,93 @@ class DeviceRecordBatch(RecordBatch):
     def __repr__(self) -> str:
         return (f"DeviceRecordBatch(n={self.n}, schema={self.schema!r}, "
                 f"ts=[{self.ts_min},{self.ts_max}])")
+
+
+class _Pending:
+    """Truthy non-None placeholder for an unrealized device column set.
+    Only ever observed by ``is None`` checks on the hot path (watermark
+    binding, ingest branch selection) — any code that would USE the
+    arrays goes through ``dcolumns``/``device_column`` first, which
+    realizes."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<unrealized device columns>"
+
+
+_PENDING = _Pending()
+
+
+class LazyDeviceBatch(DeviceRecordBatch):
+    """A device batch that has not been generated yet — the handle the
+    certified fused chain moves instead of data.
+
+    When the fusion certificate lowers a ``source-decode -> window-step``
+    prefix (graph/fusion.py ``lowered_prefix``), the device datagen
+    reader stops dispatching its per-batch decode program and emits one
+    of these instead: index ``start``, length ``n``, the prior batch's
+    tail timestamp, and the analytic event-time bounds the watermark /
+    pane bookkeeping need. The window operator folds the batch with ONE
+    composed decode+step dispatch (runtime/compiled.py) — the columns
+    are never materialized separately.
+
+    Every other consumer (degraded mode, validate-batches screening,
+    dead-letter quarantine, checkpoint in-flight capture) realizes the
+    columns on first touch by running the reader's ordinary decode
+    program — performance degrades gracefully to correctness, exactly
+    like ``DeviceRecordBatch``'s lazy host materialization."""
+
+    __slots__ = ("reader", "start", "prev_last", "_realized", "_delivered")
+
+    def __init__(self, schema: Schema, reader, start: int, n: int,
+                 prev_last, ts_min: int, ts_max: int,
+                 ts_column: Optional[str] = None):
+        self.schema = schema
+        self.reader = reader
+        self.start = int(start)       # reader index of the first record
+        self.prev_last = prev_last    # prior batch tail ts (device or host)
+        self.n = int(n)
+        self.ts_min = int(ts_min)
+        self.ts_max = int(ts_max)
+        self.ts_column = ts_column
+        self._host = None
+        self._realized = None         # (dcolumns, dtimestamps) once run
+        self._delivered = False
+
+    def deliver(self, viol, last) -> None:
+        """Hand the decode's monotonicity outputs back to the reader —
+        exactly once, whether the fused dispatch or a fallback
+        realization produced them (the reader's deferred contract check
+        and cross-batch tail both depend on them)."""
+        if not self._delivered:
+            self._delivered = True
+            self.reader._accept_monotonic(viol, last)
+
+    def realize(self) -> tuple:
+        """Run the reader's decode program for this batch (the unfused
+        fallback) and deliver its monotonicity outputs."""
+        if self._realized is None:
+            dcols, dts, viol, last = self.reader._realize_batch(
+                self.n, self.start, self.prev_last)
+            self._realized = (dcols, dts)
+            self.deliver(viol, last)
+        return self._realized
+
+    # parent __slots__ descriptors are shadowed by these properties: the
+    # column handles do not exist until someone genuinely needs them
+    @property
+    def dcolumns(self):
+        return self.realize()[0]
+
+    @property
+    def dtimestamps(self):
+        if self._realized is None:
+            return _PENDING if self.ts_column is not None else None
+        return self._realized[1]
+
+    def device_column(self, name: str):
+        return self.realize()[0][name]
+
+    def __repr__(self) -> str:
+        state = "realized" if self._realized is not None else "lazy"
+        return (f"LazyDeviceBatch(n={self.n}, start={self.start}, "
+                f"{state}, ts=[{self.ts_min},{self.ts_max}])")

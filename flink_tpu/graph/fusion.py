@@ -1,0 +1,480 @@
+"""Fusion certifier: graph-level static analysis of the JobGraph that
+proves which operator chains are lowerable to ONE XLA dispatch — and
+names precisely why the rest are not.
+
+The StreamGraph docstring has long asserted "when all are jax-traceable
+the whole chain compiles into one XLA program"; this module is the
+proof obligation behind that claim. ``certify`` walks every chained
+JobVertex, classifies each operator's device-safety, and emits a
+:class:`FusionCertificate` naming the maximal legal fusable sub-chains
+("runs"). Every boundary that *rejects* fusion — a host-effectful op, a
+serializer/schema boundary, a shuffle where a forward edge was
+possible, a timer/side-output escape — produces a PLAN6xx finding that
+`analysis/plan_rules.py` surfaces through the tpu-lint gate.
+
+Legal flush points (never findings): sinks, keyed exchanges into
+keyed-stateful operators, and the coalescing flush points
+(watermark/barrier/schema-change) that already bound a fused dispatch.
+
+The runtime consumes the certificate: ``cluster/local.py`` lowers a
+certified ``source-decode -> window-step`` prefix (tiny Q5's shape)
+into a single donated program (``runtime/compiled.py``), and Tier-B
+rules JX601-603 audit the programs that lowering produces.
+"""
+
+from __future__ import annotations
+
+import inspect
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+__all__ = ["VERDICTS", "PlanFinding", "FusedOp", "ChainReport",
+           "FusionCertificate", "certify", "CERTIFICATE_LOG",
+           "capture_certificates", "exercise_certificates"]
+
+# Certificate verdict vocabulary — doc-locked against docs/ANALYSIS.md
+# (tests/test_fusion.py asserts the table there lists exactly these).
+VERDICTS = ("CERTIFIED", "PARTIAL", "REJECTED")
+
+# Operator categories. Fusable: a run may start at a device source and
+# extend through pure ops; a device window aggregate certifies as the
+# run's keyed partial-agg tail. Flush categories end a run legally.
+_FUSABLE = ("source-device", "pure")
+_FLUSH = ("sink", "keyed-device", "keyed-host", "window-device",
+          "source-host", "two-input")
+_CUTTER_RULE = {"host-effectful": "PLAN601", "serializer": "PLAN602",
+                "timer": "PLAN604", "unknown": "PLAN601"}
+
+
+@dataclass(frozen=True)
+class PlanFinding:
+    """One rejected fusion boundary, anchored to the operator class."""
+    rule: str
+    message: str
+    file: str       # repo-relative posix path of the rejecting op class
+    line: int
+    symbol: str     # "<vertex uid>:<node name>" — stable across edits
+
+
+@dataclass
+class FusedOp:
+    node_id: int
+    name: str
+    category: str
+    detail: str
+    file: str
+    line: int
+
+
+@dataclass
+class ChainReport:
+    vertex_id: str
+    uid: str
+    name: str
+    parallelism: int
+    ops: list[FusedOp] = field(default_factory=list)
+    verdict: str = "REJECTED"
+    # maximal legal fusable sub-chains, as lists of stream-node ids
+    certified: list[list[int]] = field(default_factory=list)
+    # the prefix the runtime will actually lower to one dispatch
+    # (source -> device window, parallelism 1, fusion enabled)
+    lowered_prefix: list[int] = field(default_factory=list)
+    findings: list[PlanFinding] = field(default_factory=list)
+
+    def op(self, node_id: int) -> Optional[FusedOp]:
+        for o in self.ops:
+            if o.node_id == node_id:
+                return o
+        return None
+
+
+@dataclass
+class FusionCertificate:
+    job_name: str
+    fusion_enabled: bool
+    chains: list[ChainReport] = field(default_factory=list)
+
+    def findings(self) -> list[PlanFinding]:
+        out = []
+        for c in self.chains:
+            out.extend(c.findings)
+        return out
+
+    def chain_for_vertex(self, vertex_id: str) -> Optional[ChainReport]:
+        for c in self.chains:
+            if c.vertex_id == vertex_id:
+                return c
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "job": self.job_name,
+            "fusion_enabled": self.fusion_enabled,
+            "chains": [{
+                "vertex": c.vertex_id, "uid": c.uid, "name": c.name,
+                "parallelism": c.parallelism, "verdict": c.verdict,
+                "ops": [{"node": o.node_id, "name": o.name,
+                         "category": o.category, "detail": o.detail,
+                         "location": f"{o.file}:{o.line}"} for o in c.ops],
+                "certified": c.certified,
+                "lowered_prefix": c.lowered_prefix,
+                "findings": [{"rule": f.rule, "message": f.message,
+                              "location": f"{f.file}:{f.line}",
+                              "symbol": f.symbol} for f in c.findings],
+            } for c in self.chains],
+        }
+
+
+# Recent certificates, newest last — populated by every certify() call.
+# analysis/plan_rules.py reads this; tests seed it directly.
+CERTIFICATE_LOG: deque = deque(maxlen=64)
+
+
+# ---------------------------------------------------------------------------
+# Classification
+
+
+def _repo_rel(path: Optional[str]) -> str:
+    if not path:
+        return "<unknown>"
+    p = Path(path)
+    for parent in p.parents:
+        if parent.name == "flink_tpu":
+            return p.relative_to(parent.parent).as_posix()
+    return p.name
+
+
+def _class_location(cls: type) -> tuple[str, int]:
+    try:
+        f = inspect.getsourcefile(cls)
+        line = inspect.getsourcelines(cls)[1]
+        return _repo_rel(f), line
+    except (OSError, TypeError):
+        return "<unknown>", 0
+
+
+def _classify_operator(op: Any) -> tuple[str, str]:
+    """Device-safety category of an instantiated operator. Reuses the
+    same class facts Tier A keys on: vectorized batch methods are the
+    jax-traceable surface; row loops decode host rows (a serializer
+    boundary); timers and side collectors escape the dispatch."""
+    from ..runtime.operators.device_window import DeviceWindowAggOperator
+    from ..runtime.operators.simple import (
+        BatchFnOperator, FilterOperator, FlatMapOperator, KeyedProcessOperator,
+        MapOperator,
+    )
+
+    if isinstance(op, DeviceWindowAggOperator):
+        return "window-device", "keyed partial-agg tail (one-dispatch step)"
+    mod = type(op).__module__
+    name = type(op).__name__
+    if name in ("DeviceSessionWindowOperator", "MeshWindowAggOperator",
+                "DeviceGroupAggOperator"):
+        return "keyed-device", "keyed device aggregate (own fused step)"
+    if isinstance(op, (KeyedProcessOperator,)) or name in (
+            "CepOperator", "AsyncWaitOperator", "WindowOperator"):
+        return "timer", "timer/side-output surface escapes the dispatch"
+    if isinstance(op, BatchFnOperator):
+        if getattr(op, "traceable", False):
+            return "pure", "jax-traceable columnwise batch fn"
+        return "host-effectful", "opaque batch fn (not declared traceable)"
+    if isinstance(op, MapOperator):
+        from ..core.functions import MapFunction
+        fn = getattr(op, "_fn", None)
+        if fn is not None and \
+                type(fn).map_batch is not MapFunction.map_batch:
+            return "pure", "vectorized map_batch"
+        return "serializer", "row-loop map decodes host rows"
+    if isinstance(op, FilterOperator):
+        from ..core.functions import FilterFunction
+        fn = getattr(op, "_fn", None)
+        if fn is not None and \
+                type(fn).filter_batch is not FilterFunction.filter_batch:
+            return "pure", "vectorized filter_batch"
+        return "serializer", "row-loop filter decodes host rows"
+    if isinstance(op, FlatMapOperator):
+        return "serializer", "row-loop flat_map decodes host rows"
+    if mod.startswith("flink_tpu.sql"):
+        return "keyed-host", "host keyed SQL operator (legal flush point)"
+    return "host-effectful", f"unclassified operator {name}"
+
+
+def _classify_node(node: Any) -> FusedOp:
+    """StreamNode -> FusedOp. Instantiating the factory is safe for the
+    operators we classify (heavy setup lives in setup()/open())."""
+    if node.kind == "source":
+        src = node.source
+        file, line = _class_location(type(src))
+        if getattr(src, "_device", getattr(src, "device", False)):
+            return FusedOp(node.id, node.name, "source-device",
+                           "device-resident generator batches", file, line)
+        return FusedOp(node.id, node.name, "source-host",
+                       "host-resident source batches", file, line)
+    if node.kind == "sink":
+        cat, detail = "sink", "chain flush point"
+    elif node.kind == "two_input":
+        cat, detail = "two-input", "two-input barrier"
+    elif node.traceable:
+        cat, detail = "pure", "declared jax-traceable"
+    else:
+        cat, detail = "unknown", "operator factory failed to classify"
+    if node.kind == "one_input" and node.operator_factory is not None:
+        try:
+            op = node.operator_factory()
+            c, d = _classify_operator(op)
+            file, line = _class_location(type(op))
+            if node.traceable and c in ("host-effectful", "serializer",
+                                        "unknown"):
+                c, d = "pure", "declared jax-traceable"
+            return FusedOp(node.id, node.name, c, d, file, line)
+        except Exception as e:  # classification must never kill compile
+            return FusedOp(node.id, node.name, "unknown",
+                           f"factory raised during classification: {e!r}",
+                           "<unknown>", 0)
+    if node.kind == "sink" and node.operator_factory is not None:
+        try:
+            file, line = _class_location(type(node.operator_factory()))
+        except Exception:
+            file, line = "<unknown>", 0
+        return FusedOp(node.id, node.name, cat, detail, file, line)
+    return FusedOp(node.id, node.name, cat, detail, "<unknown>", 0)
+
+
+# ---------------------------------------------------------------------------
+# Certification
+
+
+def _walk_chain(report: ChainReport, side_tagged: set[int]) -> None:
+    """Split a chained vertex into maximal fusable runs; every run cut
+    by a non-flush category is a rejected boundary -> PLAN finding."""
+    run: list[FusedOp] = []
+
+    def close(cutter: Optional[FusedOp], rule: Optional[str]) -> None:
+        nonlocal run
+        if len(run) >= 2:
+            report.certified.append([o.node_id for o in run])
+            if cutter is not None and rule is not None:
+                report.findings.append(PlanFinding(
+                    rule=rule,
+                    message=(f"fusable run [{', '.join(o.name for o in run)}]"
+                             f" is cut by {cutter.name!r}: {cutter.detail}"),
+                    file=cutter.file, line=cutter.line,
+                    symbol=f"{report.uid}:{cutter.name}"))
+        run = []
+
+    for op in report.ops:
+        if op.node_id in side_tagged and run:
+            # a side output escapes the candidate fused region: records
+            # leave mid-dispatch, so the run ends here (PLAN604)
+            report.findings.append(PlanFinding(
+                rule="PLAN604",
+                message=(f"side output escapes the fusable run at "
+                         f"{op.name!r}; fusion stops at the tag"),
+                file=op.file, line=op.line,
+                symbol=f"{report.uid}:{op.name}:side"))
+            close(None, None)
+        if op.category in _FUSABLE:
+            if op.category == "source-device" and run:
+                close(None, None)  # defensive: sources only head a chain
+            run.append(op)
+            continue
+        if op.category == "window-device":
+            # certified keyed partial-agg tail — its own one-dispatch
+            # step even when nothing fusable precedes it
+            run.append(op)
+            report.certified.append([o.node_id for o in run])
+            run = []
+            continue
+        if op.category in _FLUSH:
+            close(None, None)    # legal flush point, no finding
+            continue
+        close(op, _CUTTER_RULE.get(op.category, "PLAN601"))
+    close(None, None)
+
+    # Verdict: CERTIFIED = every boundary in the chain is a legal flush
+    # point (findings name the rejected ones); PARTIAL = rejected
+    # boundaries exist but some run still certified; REJECTED = rejected
+    # boundaries and nothing certified.
+    if report.findings:
+        report.verdict = "PARTIAL" if report.certified else "REJECTED"
+    else:
+        report.verdict = "CERTIFIED"
+
+
+def certify(stream_graph: Any, job_graph: Any,
+            config: Any = None) -> FusionCertificate:
+    """Build the fusion certificate for a compiled job. Pure analysis —
+    never mutates either graph; the result is appended to
+    ``CERTIFICATE_LOG`` and (when fusion is enabled) attached to the
+    JobGraph by the environment for the deploy-time lowering."""
+    from ..core.config import PipelineOptions
+    enabled = bool(config.get(PipelineOptions.FUSION)) if config is not None \
+        else False
+    cert = FusionCertificate(job_name=getattr(job_graph, "name", "job"),
+                             fusion_enabled=enabled)
+
+    side_tagged = {e.source_id for e in stream_graph.edges
+                   if e.side_tag is not None}
+
+    for vid, vertex in job_graph.vertices.items():
+        report = ChainReport(vertex_id=vid, uid=vertex.uid,
+                             name=vertex.name,
+                             parallelism=vertex.parallelism)
+        for node in vertex.chained_nodes:
+            report.ops.append(_classify_node(node))
+        _walk_chain(report, side_tagged)
+        # runtime lowering: a certified run that starts at the device
+        # source heading this vertex and ends at a DeviceWindowAggOperator
+        # lowers to one dispatch (parallelism 1 only — the keyed exchange
+        # it absorbs is forward-equivalent at a single subtask)
+        if enabled and vertex.parallelism == 1 and report.certified:
+            head_run = report.certified[0]
+            ops_by_id = {o.node_id: o for o in report.ops}
+            first, last = ops_by_id[head_run[0]], ops_by_id[head_run[-1]]
+            if (first.node_id == vertex.chained_nodes[0].id
+                    and first.category == "source-device"
+                    and last.category == "window-device"):
+                report.lowered_prefix = list(head_run)
+        cert.chains.append(report)
+
+    # PLAN603: a shuffle (non-forward exchange) between two operators
+    # that would otherwise fuse — the boundary costs a dispatch + a
+    # serialize/partition round-trip that a forward edge would not.
+    for e in job_graph.edges:
+        if e.side_tag is not None:
+            continue
+        src = cert.chain_for_vertex(e.source_vertex)
+        dst = cert.chain_for_vertex(e.target_vertex)
+        if src is None or dst is None or not src.ops or not dst.ops:
+            continue
+        tail, head = src.ops[-1], dst.ops[0]
+        keyed_into_state = (e.partitioner_name == "hash"
+                            and head.category in ("window-device",
+                                                  "keyed-device",
+                                                  "keyed-host", "timer"))
+        if keyed_into_state:
+            continue  # the keyed exchange IS the legal flush point
+        if (e.partitioner_name != "forward" or e.feedback) \
+                and tail.category in _FUSABLE \
+                and head.category in ("pure",) \
+                and src.parallelism == dst.parallelism:
+            chain = dst if head.category == "pure" else src
+            chain.findings.append(PlanFinding(
+                rule="PLAN603",
+                message=(f"non-forward edge ({e.partitioner_name}"
+                         f"{', feedback' if e.feedback else ''}) between "
+                         f"fusable operators {tail.name!r} -> {head.name!r} "
+                         "at equal parallelism: a forward edge would fuse"),
+                file=head.file, line=head.line,
+                symbol=f"{dst.uid}:{head.name}:edge"))
+            chain.verdict = "PARTIAL" if chain.certified else "REJECTED"
+
+    CERTIFICATE_LOG.append(cert)
+    return cert
+
+
+# ---------------------------------------------------------------------------
+# Capture harness: certify example pipelines without running them
+
+
+class _Absorb:
+    """Duck-typed stand-in for a job/result: every attribute is a no-op
+    callable that returns another absorber, so example scripts survive
+    result plumbing after a stubbed execute."""
+
+    def __call__(self, *a, **k):
+        return self
+
+    def __getattr__(self, _name):
+        return self
+
+    def __iter__(self):
+        return iter(())
+
+    def __bool__(self):
+        return False
+
+
+def capture_certificates(path: str, argv: Optional[list] = None
+                         ) -> tuple[list[FusionCertificate], Optional[str]]:
+    """Run an example script (or a .sql file through the Table API) with
+    execution stubbed out: every execute()/submit() compiles the graphs,
+    certifies them, and returns a dummy. Returns (certificates, error) —
+    ``error`` is the tolerated script failure, if any, once capture ran."""
+    import runpy
+    import sys
+
+    from ..api.environment import StreamExecutionEnvironment
+
+    captured: list[FusionCertificate] = []
+
+    def _capture(env) -> None:
+        from ..graph.stream_graph import build_job_graph, build_stream_graph
+        sg = build_stream_graph(env._sinks, env.config)
+        jg = build_job_graph(sg, env.config)
+        captured.append(certify(sg, jg, env.config))
+        env._transformations = []
+        env._sinks = []
+
+    def fake_execute(self, *a, **k):
+        _capture(self)
+        return _Absorb()
+
+    def fake_submit(self, env, *a, **k):
+        _capture(env)
+        return "captured-job"
+
+    patches = [(StreamExecutionEnvironment, "execute", fake_execute),
+               (StreamExecutionEnvironment, "execute_async", fake_execute)]
+    try:
+        from ..cluster.dispatcher import ClusterClient, Dispatcher
+        patches.append((ClusterClient, "submit", fake_submit))
+        patches.append((ClusterClient, "wait",
+                        lambda self, *a, **k: _Absorb()))
+        patches.append((Dispatcher, "start", lambda self, *a, **k: 0))
+    except ImportError:  # pragma: no cover
+        pass
+
+    saved = [(cls, name, getattr(cls, name)) for cls, name, _ in patches]
+    for cls, name, fn in patches:
+        setattr(cls, name, fn)
+    old_argv = sys.argv
+    error: Optional[str] = None
+    try:
+        sys.argv = [str(path)] + list(argv or [])
+        if str(path).endswith(".sql"):
+            from ..sql.table_env import TableEnvironment
+            t_env = TableEnvironment.create()
+            for stmt in Path(path).read_text().split(";"):
+                if stmt.strip():
+                    t_env.execute_sql(stmt)
+        else:
+            runpy.run_path(str(path), run_name="__main__")
+    except SystemExit as e:
+        if e.code not in (0, None):
+            error = f"SystemExit({e.code})"
+    except BaseException as e:  # tolerated once capture ran
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        sys.argv = old_argv
+        for cls, name, fn in saved:
+            setattr(cls, name, fn)
+    return captured, error
+
+
+def exercise_certificates(examples_dir: Optional[Path] = None
+                          ) -> list[FusionCertificate]:
+    """Certify every example pipeline (the lint gate's corpus when no
+    certificates were captured in-process)."""
+    if examples_dir is None:
+        examples_dir = Path(__file__).resolve().parent.parent.parent \
+            / "examples"
+    out: list[FusionCertificate] = []
+    if not examples_dir.is_dir():
+        return out
+    for p in sorted(examples_dir.glob("*.py")):
+        certs, _err = capture_certificates(str(p))
+        out.extend(certs)
+    return out

@@ -259,6 +259,9 @@ class JobGraph:
     vertices: dict[str, JobVertex] = field(default_factory=dict)
     edges: list[JobEdge] = field(default_factory=list)
     config: Configuration = field(default_factory=Configuration)
+    # FusionCertificate attached by the environment when
+    # pipeline.fusion.enabled — deploy reads lowered_prefix per vertex
+    certificate: Any = None
 
     def in_edges(self, vid: str) -> list[JobEdge]:
         return [e for e in self.edges if e.target_vertex == vid]
@@ -285,12 +288,42 @@ class JobGraph:
 def build_job_graph(g: StreamGraph, config: Configuration,
                     name: str = "job") -> JobGraph:
     chaining = config.get(PipelineOptions.CHAINING_ENABLED)
+    fusion = config.get(PipelineOptions.FUSION)
+    _window_head: dict[int, bool] = {}
+
+    def device_window_head(node: StreamNode) -> bool:
+        """Does this node's factory build a device window aggregate?
+        (Instantiation is cheap: backend creation lives in setup().)"""
+        if node.id not in _window_head:
+            ok = False
+            if node.kind == "one_input" and node.operator_factory is not None:
+                try:
+                    from ..runtime.operators.device_window import (
+                        DeviceWindowAggOperator,
+                    )
+                    ok = isinstance(node.operator_factory(),
+                                    DeviceWindowAggOperator)
+                except Exception:
+                    ok = False
+            _window_head[node.id] = ok
+        return _window_head[node.id]
 
     def chainable(e: StreamEdge) -> bool:
         if not chaining or e.side_tag is not None or e.feedback:
             return False
         up, down = g.nodes[e.source_id], g.nodes[e.target_id]
-        return (e.partitioner_name == "forward"
+        forward_ok = e.partitioner_name == "forward"
+        if not forward_ok and fusion:
+            # whole-chain fusion: a hash exchange at parallelism 1 is
+            # forward-equivalent (every record lands on subtask 0), so
+            # the keyed edge into a device window aggregate may chain —
+            # that is what lets a certified source -> window prefix
+            # lower to one dispatch (graph/fusion.py)
+            forward_ok = (e.partitioner_name == "hash"
+                          and up.parallelism == 1
+                          and down.parallelism == 1
+                          and device_window_head(down))
+        return (forward_ok
                 and up.parallelism == down.parallelism
                 and up.slot_sharing_group == down.slot_sharing_group
                 and down.kind in ("one_input", "sink")

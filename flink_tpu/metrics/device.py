@@ -77,6 +77,10 @@ class DeviceStats:
         self._panes_sealed = 0
         self._batches_coalesced = 0
         self._fire_merge_rows = 0
+        # whole-chain fusion accounting (PR 11): micro-batches ingested
+        # through a certified fused chain program — ONE dispatch covering
+        # source-decode + window step (graph/fusion.py certificate)
+        self._chain_dispatches = 0
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -207,6 +211,15 @@ class DeviceStats:
     def note_fire_merge_rows(self, n: int) -> None:
         with self._lock:
             self._fire_merge_rows += int(n)
+
+    def note_chain_dispatch(self, n: int = 1) -> None:
+        with self._lock:
+            self._chain_dispatches += int(n)
+
+    @property
+    def chain_dispatches(self) -> int:
+        with self._lock:
+            return self._chain_dispatches
 
     @property
     def panes_sealed(self) -> int:
@@ -340,6 +353,7 @@ class DeviceStats:
                 "panes_sealed_total": self._panes_sealed,
                 "batches_coalesced_total": self._batches_coalesced,
                 "fire_merge_rows_read": self._fire_merge_rows,
+                "chain_fused_dispatches_total": self._chain_dispatches,
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -389,6 +403,7 @@ class DeviceStats:
             self._panes_sealed = 0
             self._batches_coalesced = 0
             self._fire_merge_rows = 0
+            self._chain_dispatches = 0
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -600,3 +615,6 @@ def bind_device_metrics(registry) -> None:
     g.gauge("panes_sealed_total", lambda: s.panes_sealed)
     g.gauge("batches_coalesced_total", lambda: s.batches_coalesced)
     g.gauge("fire_merge_rows_read", lambda: s.fire_merge_rows)
+    # whole-chain fusion (prometheus:
+    # flink_tpu_device_chain_fused_dispatches_total)
+    g.gauge("chain_fused_dispatches_total", lambda: s.chain_dispatches)

@@ -81,35 +81,18 @@ from ...ops.topk import masked_topk as _masked_topk  # noqa: E402
 # passes (see ops/topk.py)
 
 
-@instrumented_program_cache("device_window.step")
-def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
-                  dirty_block: int, spill_maxp: int = 0):
-    """ONE compiled program per batch for the device-resident ingest path:
-    pane assignment + late masking + hash-table lookup-or-insert + every
-    scatter-fold, over columns that are ALREADY in HBM (DeviceRecordBatch).
-    This is the whole per-batch hot loop in a single dispatch — the analog
-    of the reference's record loop StreamTask.processInput:588 →
-    WindowOperator.processElement:278, executed once per micro-batch with
-    zero host<->device transfers. State buffers are donated so XLA updates
-    them in place instead of copying [ring, capacity] arrays every batch.
-
-    ``fold_sig`` is a tuple of (fold_kind, state_name, field). The count
-    plane ("__count__") folds implicitly.
-
-    ``spill_maxp`` > 0 enables the deferred-spill split (HBM budget +
-    defer_overflow): records of spilled key groups — and failed inserts —
-    are excluded from the device fold and compacted into the ``stage``
-    buffers for the host tier, still with zero host syncs; the per-group
-    LRU clock updates on device. Stage overflow (more rows than the
-    staging capacity between watermarks) counts into ``dropped`` and
-    fails loudly at the next health check.
-    """
+def _step_body(fold_sig: tuple, ring: int, pane: int, offset: int,
+               dirty_block: int, spill_maxp: int = 0):
+    """The UNJITTED ingest-step body — pane assignment + late masking +
+    hash-table lookup-or-insert + every scatter-fold. ``_step_program``
+    wraps it in a donated jit (the standalone per-batch dispatch); the
+    fused-chain lowering (runtime/compiled.py) composes it with the
+    source decode under ONE jit instead, so the certified
+    source→window prefix is a single XLA dispatch."""
     from ...ops.segment_ops import scatter_fold
 
     spill = spill_maxp > 0
-    donate = (0, 1, 2, 3, 4, 5, 6) if spill else (0, 1, 2, 3, 4)
 
-    @partial(jax.jit, donate_argnums=donate)
     def step_fn(table, arrays, dropped, late, dirty, stage, touch, keys, ts,
                 cols, spilled, batch_no, first_open, n_valid):
         panes = (ts.astype(jnp.int64) - offset) // pane
@@ -174,6 +157,34 @@ def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
         return table, out, dropped, late, dirty, stage, touch, token
 
     return step_fn
+
+
+@instrumented_program_cache("device_window.step")
+def _step_program(fold_sig: tuple, ring: int, pane: int, offset: int,
+                  dirty_block: int, spill_maxp: int = 0):
+    """ONE compiled program per batch for the device-resident ingest path
+    (see ``_step_body`` for what runs inside), over columns that are
+    ALREADY in HBM (DeviceRecordBatch). This is the whole per-batch hot
+    loop in a single dispatch — the analog of the reference's record loop
+    StreamTask.processInput:588 → WindowOperator.processElement:278,
+    executed once per micro-batch with zero host<->device transfers.
+    State buffers are donated so XLA updates them in place instead of
+    copying [ring, capacity] arrays every batch.
+
+    ``fold_sig`` is a tuple of (fold_kind, state_name, field). The count
+    plane ("__count__") folds implicitly.
+
+    ``spill_maxp`` > 0 enables the deferred-spill split (HBM budget +
+    defer_overflow): records of spilled key groups — and failed inserts —
+    are excluded from the device fold and compacted into the ``stage``
+    buffers for the host tier, still with zero host syncs; the per-group
+    LRU clock updates on device. Stage overflow (more rows than the
+    staging capacity between watermarks) counts into ``dropped`` and
+    fails loudly at the next health check.
+    """
+    donate = (0, 1, 2, 3, 4, 5, 6) if spill_maxp > 0 else (0, 1, 2, 3, 4)
+    return partial(jax.jit, donate_argnums=donate)(
+        _step_body(fold_sig, ring, pane, offset, dirty_block, spill_maxp))
 
 
 @instrumented_program_cache("device_window.native_fold")
@@ -528,6 +539,11 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         self._validate_batches = False
         self._guard: Optional[DeviceGuard] = None
         self.quarantined_batches = 0
+        # certified fused-chain lowering (graph/fusion.py lowered_prefix):
+        # armed by the deployer via enable_fused_chain, built lazily once
+        # aggregate dtypes are known
+        self._fused_spec = None     # (source, subtask, parallelism)
+        self._fused_chain = None    # runtime.compiled.FusedChain
         # wall-clock per hot-path stage (bench breakdown): ingest = pack +
         # upload + fold dispatch, fire = fire dispatch, drain = result
         # materialization + emit
@@ -555,11 +571,16 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             ctx.config.get(FaultOptions.DEGRADATION))
         self._validate_batches = bool(
             ctx.config.get(FaultOptions.VALIDATE_BATCHES))
+        # fused chains insert through the XLA probe inside the composed
+        # program; mixing the native host index's slot assignment with
+        # XLA probing on one table would place a key at two slots, so a
+        # certified chain forces the device index on
+        host_index = (bool(ctx.config.get(StateOptions.TPU_HOST_INDEX))
+                      and self._fused_spec is None)
         self._backend = TpuKeyedStateBackend(
             ctx.key_group_range, ctx.max_parallelism,
             capacity=self._capacity, defer_overflow=self._defer,
-            hbm_budget_slots=budget,
-            host_index=bool(ctx.config.get(StateOptions.TPU_HOST_INDEX)))
+            hbm_budget_slots=budget, host_index=host_index)
         # count-plane width follows the declared result bound: a COUNT
         # aggregate with value_bits <= 31 promises every per-window count
         # fits int32, which halves the fold scatter + fire merge traffic
@@ -572,6 +593,20 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         self._backend.register_array_state("__count__", "count", count_dtype,
                                            ring=self._ring)
         self._registered = False
+
+    def enable_fused_chain(self, source, subtask: int,
+                           parallelism: int) -> bool:
+        """Arm the certified source→window lowering (called by the
+        deployer when the job's FusionCertificate carries a
+        ``lowered_prefix`` for this vertex, BEFORE setup). The upstream
+        reader then emits ``LazyDeviceBatch`` handles and this operator
+        folds each with one composed decode+step dispatch. Only legal
+        under deferred-overflow semantics — the composed program checks
+        nothing synchronously, exactly like ``_ingest_device``."""
+        if not self._defer:
+            return False
+        self._fused_spec = (source, int(subtask), int(parallelism))
+        return True
 
     def _register_aggs(self, schema: Schema) -> None:
         """Accumulator dtypes follow the input columns (sum over int64
@@ -613,8 +648,15 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         if batch.n == 0:
             return
         if self._coalesce_target > 1:
-            self._coalesce_admit(batch)
-            return
+            from ...core.device_records import LazyDeviceBatch
+            if isinstance(batch, LazyDeviceBatch):
+                # a lazy chain batch is already a full micro-batch; admit
+                # it directly (flushing buffered host batches first keeps
+                # arrival order)
+                self._coalesce_flush()
+            else:
+                self._coalesce_admit(batch)
+                return
         self._process_batch_now(batch)
 
     def _process_batch_now(self, batch: RecordBatch) -> None:
@@ -636,7 +678,18 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             if batch.n == 0:
                 return
         t0 = time.perf_counter()
-        if self._degraded and not self._backend.host_index_active:
+        from ...core.device_records import LazyDeviceBatch
+        if (self._fused_spec is not None
+                and isinstance(batch, LazyDeviceBatch)
+                and batch._realized is None
+                and not self._degraded
+                and not self._backend.host_index_active
+                and not self._spill_deferred):
+            # certified fused chain: decode + fold in ONE dispatch; any
+            # condition above failing lets the lazy batch realize through
+            # the ordinary ladder below (graceful unfusing)
+            self._ingest_chain(batch)
+        elif self._degraded and not self._backend.host_index_active:
             # degradation ladder, last rung: state lives host-side, slot
             # resolution through the synchronous backend path; device
             # batches are viewed as host columns (on the CPU backend a
@@ -881,6 +934,79 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         if spill:
             self._stage = stage
             self._backend.set_touch_device(touch)
+        self._admit_token(token)
+
+    def _ingest_chain(self, batch) -> None:
+        """Certified-chain ingest: the batch is a ``LazyDeviceBatch`` —
+        no columns exist yet. ONE composed program (runtime/compiled.py)
+        decodes the batch from its start index and folds it into the
+        donated window state; pane bookkeeping on the analytic bounds is
+        identical to ``_ingest_device``."""
+        pane_lo = (batch.ts_min - self._offset) // self._pane
+        pane_hi = (batch.ts_max - self._offset) // self._pane
+        first_open = (self._fired_boundary - self._window_panes
+                      if self._fired_boundary is not None else None)
+        if first_open is not None and pane_hi < first_open:
+            # wholly late (contradicts the monotonic-source contract, so
+            # effectively unreachable): realize so the reader's deferred
+            # contract check still sees this batch's outputs
+            batch.realize()
+            self._late_dropped += batch.n
+            return
+        eff_lo = pane_lo if first_open is None else max(pane_lo, first_open)
+        self._max_seen_pane = (pane_hi if self._max_seen_pane is None
+                               else max(self._max_seen_pane, pane_hi))
+        self._min_seen_pane = (eff_lo if self._min_seen_pane is None
+                               else min(self._min_seen_pane, eff_lo))
+        self._note_open_ingest(eff_lo)
+        low = (first_open if self._fired_boundary is not None
+               else self._min_seen_pane)
+        if pane_hi - low >= self._ring:
+            raise RuntimeError(
+                f"pane ring overflow: open span [{low},{pane_hi}] exceeds "
+                f"ring {self._ring}; increase ring_size or reduce "
+                "watermark lag")
+        if self._late_dev is None:
+            self._late_dev = jnp.zeros((), jnp.int64)
+        if self._fused_chain is None:
+            from ..compiled import FusedChain
+            source, subtask, parallelism = self._fused_spec
+            self._fused_chain = FusedChain(
+                source, subtask, parallelism, self._key_column,
+                self._fold_sig(), self._ring, self._pane, self._offset,
+                self._backend.dirty_block_size)
+        chain = self._fused_chain
+        fo = np.int64(first_open if first_open is not None else MIN_TIMESTAMP)
+
+        def dispatch():
+            arrays = {n: self._backend.get_array(n)
+                      for n in self._fire_array_names()}
+            return chain.run(batch.n, batch.start, batch.prev_last,
+                             self._backend.table, arrays,
+                             self._backend.dropped_device, self._late_dev,
+                             self._backend.dirty_mask, fo)
+
+        try:
+            table, new_arrays, dropped, late, dirty, viol, last, token = \
+                self._guard.run(dispatch)
+        except DeviceSegmentError as e:
+            if self._on_segment_failure(e, batch):
+                return  # poisoned batch quarantined; state untouched
+            # degraded mid-stream: re-run through the host path (realizes
+            # the batch — nothing folded device-side, the fault fired
+            # before dispatch)
+            hb = self._host_view(batch)
+            keys = np.asarray(hb.column(self._key_column)).astype(
+                np.int64, copy=False)
+            self._ingest(hb, keys)
+            return
+        self._backend.table = table
+        for n, a in new_arrays.items():
+            self._backend.set_array(n, a)
+        self._backend._dropped = dropped
+        self._backend.set_dirty_mask(dirty)
+        self._late_dev = late
+        batch.deliver(viol, last)
         self._admit_token(token)
 
     def _alloc_stage(self) -> None:
