@@ -1188,6 +1188,122 @@ def fused(batch: int = 64, n_batches: int = 512) -> None:
     sys.stdout.flush()
 
 
+def _multichip_worker(n_devices: int, batch: int, steps: int) -> None:
+    """Runs in a SUBPROCESS whose XLA_FLAGS pinned the host-platform
+    device count before jax initialized (the count is process-start
+    fixed): one weak-scaling sharded-window run — constant per-device
+    batch, so total work grows with the mesh — printing one JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.metrics.device import DEVICE_STATS
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_window import AggDef, ShardedWindowAgg
+
+    D = n_devices
+    if len(jax.devices()) < D:
+        print(json.dumps({"n_devices": D, "error":
+                          f"only {len(jax.devices())} devices"}))
+        return
+    agg = ShardedWindowAgg(make_mesh(D),
+                           [AggDef("price", "sum", jnp.int64)],
+                           capacity=1 << 12, ring=16, max_parallelism=128)
+    state = agg.init_state()
+    rng = np.random.default_rng(11)
+    keys = jnp.asarray(rng.integers(1, 50_000, size=(D, batch)), jnp.int64)
+    cols = {"price": jnp.asarray(
+        rng.integers(1, 100, size=(D, batch)), jnp.int64)}
+    panes = jnp.asarray(rng.integers(0, 16, size=(D, batch)), jnp.int32)
+    valid = jnp.ones((D, batch), bool)
+    for _ in range(2):                                     # compile warmup
+        state, _p = agg.step(state, keys, cols, panes, valid)
+    jax.block_until_ready(state)
+    before = DEVICE_STATS.snapshot()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _p = agg.step(state, keys, cols, panes, valid)
+    jax.block_until_ready(state)
+    wall = time.perf_counter() - t0
+    after = DEVICE_STATS.snapshot()
+    print(json.dumps({
+        "n_devices": D,
+        "events_per_sec": round(D * batch * steps / wall, 2),
+        "wall_s": round(wall, 4),
+        "recompiles": after["compiles"] - before["compiles"]}))
+
+
+def multichip(device_counts=(1, 2, 4, 8), batch: int = 4096,
+              steps: int = 48) -> None:
+    """`python bench.py --multichip`: device-count sweep for the sharded
+    window path. Each count runs in its own subprocess (the XLA
+    host-platform device count is fixed at process start, so a sweep
+    cannot reuse one process) on the CPU-fallback rung with simulated
+    devices; on a real multi-chip host the same stage measures ICI.
+
+    Weak scaling, honestly labeled: the per-device batch is constant, so
+    ideal behavior is aggregate events/sec equal to the 1-device run
+    times the device count divided by the host cores actually available
+    — on a single-core CI box every simulated device timeshares one
+    core, so the printed ``scaling_efficiency`` is
+    eps_total[D] / eps_total[1]: the fraction of throughput SURVIVING
+    the exchange + psum collectives as the mesh grows (1.0 = collective
+    overhead is invisible). Writes MULTICHIP_r<NN>.json next to the
+    other round artifacts, keeping the legacy driver keys."""
+    import glob
+    import re
+
+    rec = {"n_devices": max(device_counts), "rc": 0, "ok": True,
+           "skipped": False, "tail": "",
+           "mode": "weak-scaling", "per_device_batch": batch,
+           "steps": steps, "device_counts": list(device_counts),
+           "events_per_sec": {}, "scaling_efficiency": {},
+           "recompiles": {}}
+    for n in device_counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--multichip-worker", str(n), "--batch", str(batch),
+               "--steps", str(steps)]
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            rec.update(ok=False, rc=124,
+                       tail=f"{n}-device worker timed out")
+            continue
+        line = (p.stdout.strip().splitlines() or [""])[-1]
+        try:
+            out = json.loads(line)
+        except ValueError:
+            out = {}
+        if p.returncode != 0 or "events_per_sec" not in out:
+            rec.update(ok=False, rc=p.returncode or 1,
+                       tail=(p.stderr or line)[-400:])
+            continue
+        rec["events_per_sec"][str(n)] = out["events_per_sec"]
+        rec["recompiles"][str(n)] = out.get("recompiles", -1)
+    base = rec["events_per_sec"].get(str(device_counts[0]))
+    if base:
+        for n in device_counts:
+            eps = rec["events_per_sec"].get(str(n))
+            if eps:
+                rec["scaling_efficiency"][str(n)] = round(eps / base, 4)
+    rounds = [int(m.group(1)) for f in glob.glob("MULTICHIP_r*.json")
+              for m in [re.search(r"_r(\d+)\.json$", f)] if m]
+    path = f"MULTICHIP_r{max(rounds, default=0) + 1:02d}.json"
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"metric": "multichip_scaling_report",
+                      "unit": "report", "path": path, **rec}))
+    sys.stdout.flush()
+
+
 def chaos(seed: int) -> None:
     """`python bench.py --chaos SEED`: the tiny Q5 stage with
     deterministic fault injection armed at every site (CHAOS_SPEC, seeded
@@ -1247,7 +1363,17 @@ if __name__ == "__main__":
     if "--window-panes" in sys.argv:
         i = sys.argv.index("--window-panes")
         _window_panes = tuple(int(w) for w in sys.argv[i + 1].split(","))
-    if "--suite" in sys.argv:
+    if "--multichip-worker" in sys.argv:
+        i = sys.argv.index("--multichip-worker")
+        _n = int(sys.argv[i + 1])
+        _b = (int(sys.argv[sys.argv.index("--batch") + 1])
+              if "--batch" in sys.argv else 4096)
+        _s = (int(sys.argv[sys.argv.index("--steps") + 1])
+              if "--steps" in sys.argv else 48)
+        _multichip_worker(_n, _b, _s)
+    elif "--multichip" in sys.argv:
+        multichip()
+    elif "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:
         tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,

@@ -137,6 +137,112 @@ def host_sync_rule(ctx: AnalysisContext) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# TPU102 — collectives must name a declared mesh axis
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "psum_scatter",
+                "all_gather", "all_to_all", "ppermute", "pshuffle",
+                "axis_index", "axis_size"}
+# axis is the sole/first argument for these; everything else takes it
+# second (after the operand)
+_AXIS_ARG0 = {"axis_index", "axis_size"}
+
+
+def _declared_axes(ctx: AnalysisContext) -> Set[str]:
+    """Statically resolve parallel/plan.py DECLARED_AXES without importing
+    the package (Tier A stays jax-free): string constants are taken as-is,
+    names resolve against parallel/mesh.py module-level string assigns."""
+    axes: Set[str] = set()
+    consts: Dict[str, str] = {}
+    try:
+        for node in ctx.tree(ctx.pkg_rel("parallel/mesh.py")).body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                consts[node.targets[0].id] = node.value.value
+        for node in ctx.tree(ctx.pkg_rel("parallel/plan.py")).body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "DECLARED_AXES"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and \
+                            isinstance(elt.value, str):
+                        axes.add(elt.value)
+                    elif isinstance(elt, ast.Name) and elt.id in consts:
+                        axes.add(consts[elt.id])
+    except FileNotFoundError:
+        pass
+    return axes
+
+
+def _axis_expr_ok(node: ast.AST, axes: Set[str]) -> bool:
+    """Is this axis argument provably one of the declared axes?  Accepted:
+    a matching string literal, the DATA_AXIS constant, or an identifier /
+    attribute named ``axis_name`` (the plan threads the declared axis
+    under exactly that name)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value in axes
+    if isinstance(node, ast.Name):
+        return node.id in ("DATA_AXIS", "axis_name")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("axis_name", "DATA_AXIS")
+    return False
+
+
+@rule("TPU102", "collective over an undeclared mesh axis", "A",
+      "every lax collective (psum/all_to_all/ppermute/...) must name an "
+      "axis from parallel/plan.py DECLARED_AXES — a collective over an "
+      "ad-hoc axis string either fails at trace time on a real mesh or "
+      "silently reduces over the wrong dimension after a mesh reshape; "
+      "annotate exceptions with '# lint: axis-ok <reason>'")
+def declared_axis_rule(ctx: AnalysisContext) -> List[Finding]:
+    axes = _declared_axes(ctx)
+    findings: List[Finding] = []
+    for rel in ctx.package_files():
+        try:
+            tree = ctx.tree(rel)
+        except (FileNotFoundError, SyntaxError):
+            continue
+        for node, qual in _walk_with_qualname(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            parts = dotted.split(".")
+            if parts[-1] not in _COLLECTIVES:
+                continue
+            # only lax collectives: lax.psum / jax.lax.psum; a local
+            # helper that happens to be called psum is out of scope
+            if len(parts) < 2 or parts[-2] != "lax":
+                continue
+            fn = parts[-1]
+            axis_arg = None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+            if axis_arg is None:
+                pos = 0 if fn in _AXIS_ARG0 else 1
+                if len(node.args) > pos:
+                    axis_arg = node.args[pos]
+            if axis_arg is not None and _axis_expr_ok(axis_arg, axes):
+                continue
+            if ctx.suppression(rel, node.lineno, "axis-ok"):
+                continue
+            snippet = _call_snippet(ctx, rel, node)
+            findings.append(Finding(
+                rule="TPU102", file=rel, line=node.lineno,
+                symbol=f"{qual}:{snippet}",
+                message=f"collective {fn} does not name a declared mesh "
+                        f"axis ({snippet}); declared: "
+                        f"{sorted(axes) or '<none resolved>'}",
+                hint="pass the plan's axis (DATA_AXIS / a threaded "
+                     "axis_name) or add the axis to parallel/plan.py "
+                     "DECLARED_AXES first; annotate deliberate exceptions "
+                     "'# lint: axis-ok <reason>'"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # TPU201 — singleton wiring on deploy entry points
 
 

@@ -128,6 +128,7 @@ class AnalysisSettings:
         ("WATCHDOG", ("WATCHDOG",)),
         ("TRACER", ("TRACER",)),
         ("FLIGHT_RECORDER", ("FLIGHT_RECORDER", "TRACER")),
+        ("MESH_RUNTIME", ("MESH_RUNTIME",)),
     )
     # Determinism rule: span/tracing modules where time.time() is banned
     # (monotonic-anchored clock only — see now_ms() in metrics/tracing).

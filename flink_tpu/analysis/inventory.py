@@ -252,7 +252,10 @@ def _exempt_constants(tree: ast.Module) -> Set[int]:
     site_fns = {"run", "fire", "check", "fire_with_retries",
                 "stall_bounded", "deadline_for", "trip", "StallError",
                 "note_stall", "note_verify_failure",
-                "note_restore_fallback"}
+                "note_restore_fallback",
+                # program-cache scopes ("mesh.step", ...) are an open
+                # namespace keyed off the builder, not config keys
+                "instrumented_program_cache"}
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue

@@ -292,6 +292,71 @@ def recompile_hazard_rule(ctx: AnalysisContext) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# JX505 — sharded (mesh) programs must be keyed by LOCAL shard shapes
+
+
+_MESH_SCOPE_PREFIX = "mesh."
+# repr((args, kwargs)) of a builder whose first argument is the canonical
+# local_signature tuple — see parallel/sharded_window.local_signature
+_LOCAL_KEY_PREFIX = "((('local',"
+
+
+@rule("JX505", "sharded program keyed by non-local shapes", "B",
+      "every 'mesh.*' program builder must be keyed by the local-shard "
+      "signature (parallel/sharded_window.local_signature: schema + "
+      "per-device dims) and NEVER by the device count or a global "
+      "[D, ...] shape — a global-keyed builder compiles a different "
+      "program per mesh size, so a live rescale that preserves local "
+      "shard shapes pays a recompile instead of a cache hit "
+      "(recompiles==0 across rescale is the PR 12 contract)")
+def mesh_local_key_rule(ctx: AnalysisContext) -> List[Finding]:
+    jax = _require_jax()
+    entries = [e for e in _entries()
+               if e.scope.startswith(_MESH_SCOPE_PREFIX)]
+    if not entries:
+        skip_rule("no 'mesh.*' programs registered — run a sharded "
+                  "pipeline or exercise_programs() first")
+    findings: List[Finding] = []
+    for entry in entries:
+        file, line = _entry_location(ctx, entry)
+        if not entry.build_key.startswith(_LOCAL_KEY_PREFIX):
+            findings.append(Finding(
+                rule="JX505", file=file, line=line,
+                symbol=f"{entry.scope}:not-local-keyed",
+                message=f"mesh program '{entry.scope}' build key "
+                        f"{entry.build_key[:80]!r} is not derived from "
+                        "local_signature (missing the 'local' marker as "
+                        "its first builder argument)",
+                hint="key the builder on local_signature(aggs, capacity, "
+                     "ring) + static config; bind the concrete Mesh "
+                     "inside the cache entry (see _step_program)"))
+            continue
+        # a global dispatch shape leaking into the key: any [D, ...] aval
+        # of the recorded dispatch appearing verbatim means the key varies
+        # with the mesh size (local keys carry dims, never shape tuples)
+        leaked = set()
+        for leaf in jax.tree_util.tree_leaves((entry.abstract_args,
+                                               entry.abstract_kwargs)):
+            shape = getattr(leaf, "shape", None)
+            if (shape is not None and getattr(leaf, "dtype", None)
+                    is not None and len(shape) >= 2):
+                if repr(tuple(int(d) for d in shape)) in entry.build_key:
+                    leaked.add(tuple(int(d) for d in shape))
+        if leaked:
+            findings.append(Finding(
+                rule="JX505", file=file, line=line,
+                symbol=f"{entry.scope}:global-shape-keyed",
+                message=f"mesh program '{entry.scope}' build key embeds "
+                        f"global dispatch shape(s) "
+                        f"{sorted(leaked)} — the key varies with the "
+                        "device count",
+                hint="derive the key from per-device shard dims only; "
+                     "global [D, ...] shapes belong to the traced "
+                     "arguments, not the cache key"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # JX6xx — fused-chain program audit (the fusion certifier's runtime half:
 # graph/fusion.py certifies the plan, these rules lock the programs the
 # lowering actually built; scopes are "chain.fused_prelude" — the
@@ -481,4 +546,26 @@ def exercise_programs(n_events: int = 4096, batch: int = 1024,
                 emit_topk=32, defer_overflow=True)
             .add_sink(_DiscardSink(), "audit-sink"))
         env.execute(f"tpu-lint-audit-{fire_mode}", timeout=600.0)
+
+    # sharded (mesh.*) programs: one direct step + fused fire on a tiny
+    # ShardedWindowAgg so the JX505 local-key audit has entries to lint
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_window import AggDef, ShardedWindowAgg
+
+    D = max(1, min(4, len(jax.devices())))
+    agg = ShardedWindowAgg(make_mesh(D),
+                           [AggDef("price", "sum", jnp.int64)],
+                           capacity=256, ring=8, max_parallelism=128)
+    state = agg.init_state()
+    B = 64
+    keys = (jnp.arange(D * B, dtype=jnp.int64) % 37).reshape(D, B) + 1
+    state, _ = agg.step(state, keys,
+                        {"price": jnp.ones((D, B), jnp.int64)},
+                        jnp.zeros((D, B), jnp.int32),
+                        jnp.ones((D, B), bool))
+    agg.fire_compact(state, np.arange(4), np.ones(4, bool),
+                     "price", 8)
     return sorted({e.scope for e in PROGRAM_AUDIT})

@@ -726,6 +726,8 @@ class DistributedHost:
         from ..metrics.tracing import TRACER
         TRACER.configure(config)
         set_compile_tracer(TRACER if TRACER.enabled else None)
+        from ..parallel.plan import MESH_RUNTIME
+        MESH_RUNTIME.configure(config)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "

@@ -184,6 +184,11 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     from ..metrics.tracing import TRACER
     TRACER.configure(config)
     set_compile_tracer(TRACER if TRACER.enabled else None)
+    # the mesh runtime (axis rules + live-rescale policy) is process-global
+    # for the same reason the fault injector is: sharded programs compiled
+    # by ANY task must agree on the partition rules
+    from ..parallel.plan import MESH_RUNTIME
+    MESH_RUNTIME.configure(config)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape
@@ -285,6 +290,96 @@ def restart_region(job: "LocalJob", job_graph: JobGraph,
             job._exec_set(tid, "RUNNING")
     restart_sb.finish()
     return affected
+
+
+def live_rescale(job: "LocalJob", n_devices: int,
+                 timeout: Optional[float] = None) -> dict:
+    """Coordinator-driven live rescale: change every mesh operator's
+    worker set (device count) inside a RUNNING job, barrier-aligned and
+    exactly-once, without a restart.
+
+    Protocol (the elastic counterpart of restart_region): stage the new
+    device count on every mesh operator (request_rescale), then trigger
+    ONE aligned checkpoint — each operator applies the staged change on
+    its mailbox thread at its snapshot point, where every buffered row is
+    folded and every in-flight fire drained, so the barrier that makes
+    the checkpoint consistent is the same event that makes the worker-set
+    switch consistent. State moves via the checkpoint page format
+    (digest-verified; see parallel/rescale.py); derived window planes are
+    rebuilt on the new mesh, not shipped. Returns the merged migration
+    stats ({keygroups_migrated, bytes_moved, epoch, ...} summed/maxed
+    over operators).
+    """
+    from ..metrics.tracing import TRACER
+    from ..parallel.plan import MESH_RUNTIME
+    if not MESH_RUNTIME.rescale_enabled:
+        raise RuntimeError(
+            "live rescale is disabled (mesh.rescale.enabled=false)")
+    if timeout is None:
+        timeout = MESH_RUNTIME.rescale_timeout_ms / 1000.0
+    targets = []
+    for tid in list(job.tasks):
+        chain = getattr(job.tasks[tid], "chain", None)
+        for op in (chain.operators if chain is not None else ()):
+            if hasattr(op, "request_rescale"):
+                targets.append((tid, op))
+    if not targets:
+        raise ValueError("live_rescale: job has no mesh operators")
+    sb = (TRACER.span("rescale", "Rescale")
+          .set_attribute("job", job.job_graph.name)
+          .set_attribute("operators", len(targets))
+          .set_attribute("new_devices", int(n_devices)))
+    try:
+        old_epochs = {tid: op._rescale_epoch for tid, op in targets}
+        for _, op in targets:
+            op.request_rescale(n_devices)
+        coordinator = getattr(job, "coordinator", None)
+        ephemeral = None
+        if coordinator is None:
+            # no periodic checkpointing on this job: stand up a one-shot
+            # coordinator purely to circulate the alignment barrier
+            from ..checkpoint.coordinator import CheckpointCoordinator
+            ephemeral = coordinator = CheckpointCoordinator(
+                job, job.config, tracer=TRACER if TRACER.enabled else None)
+        try:
+            pending = coordinator.trigger_checkpoint()
+            if not pending.done.wait(timeout):
+                raise TimeoutError(
+                    f"live rescale to {n_devices} devices timed out after "
+                    f"{timeout:.1f}s (mesh.rescale.timeout) waiting for the "
+                    f"alignment barrier")
+            if pending.completed is None:
+                raise RuntimeError(
+                    f"live rescale checkpoint {pending.checkpoint_id} was "
+                    f"declined; worker set unchanged")
+        finally:
+            if ephemeral is not None:
+                job.checkpoint_listener = None
+        stale = [tid for tid, op in targets
+                 if op._rescale_epoch <= old_epochs[tid]]
+        if stale:
+            raise RuntimeError(
+                f"live rescale barrier completed but operators {stale} did "
+                f"not bump their mesh epoch")
+        merged = {"new_devices": int(n_devices), "operators": len(targets),
+                  "keygroups_migrated": 0, "bytes_moved": 0,
+                  "duration_ms": 0.0, "epoch": 0}
+        for _, op in targets:
+            st = op._last_rescale_stats or {}
+            merged["keygroups_migrated"] += st.get("keygroups_migrated", 0)
+            merged["bytes_moved"] += st.get("bytes_moved", 0)
+            merged["duration_ms"] = max(merged["duration_ms"],
+                                        st.get("duration_ms", 0.0))
+            merged["epoch"] = max(merged["epoch"], st.get("epoch", 0))
+        sb.set_attribute("keygroups_migrated", merged["keygroups_migrated"])
+        sb.set_attribute("bytes_moved", merged["bytes_moved"])
+        sb.set_attribute("epoch", merged["epoch"])
+        return merged
+    except BaseException as e:
+        sb.set_attribute("error", repr(e))
+        raise
+    finally:
+        sb.finish()
 
 
 def _deploy_vertices(job: "LocalJob", job_graph: JobGraph,

@@ -81,6 +81,14 @@ class DeviceStats:
         # through a certified fused chain program — ONE dispatch covering
         # source-decode + window step (graph/fusion.py certificate)
         self._chain_dispatches = 0
+        # live-rescale accounting (PR 12): worker-set changes applied
+        # without a restart, key groups whose owner changed, page bytes
+        # shipped through the checkpoint transfer format, and total time
+        # spent inside the barrier-aligned switch
+        self._rescales = 0
+        self._keygroups_migrated = 0
+        self._rescale_bytes_moved = 0
+        self._rescale_ms = 0.0
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -236,6 +244,35 @@ class DeviceStats:
         with self._lock:
             return self._fire_merge_rows
 
+    # -- live-rescale accounting ---------------------------------------------
+    def note_rescale(self, keygroups_migrated: int, bytes_moved: int,
+                     duration_ms: float) -> None:
+        with self._lock:
+            self._rescales += 1
+            self._keygroups_migrated += int(keygroups_migrated)
+            self._rescale_bytes_moved += int(bytes_moved)
+            self._rescale_ms += float(duration_ms)
+
+    @property
+    def rescales(self) -> int:
+        with self._lock:
+            return self._rescales
+
+    @property
+    def keygroups_migrated(self) -> int:
+        with self._lock:
+            return self._keygroups_migrated
+
+    @property
+    def rescale_bytes_moved(self) -> int:
+        with self._lock:
+            return self._rescale_bytes_moved
+
+    @property
+    def rescale_ms(self) -> float:
+        with self._lock:
+            return self._rescale_ms
+
     # -- tracing accounting --------------------------------------------------
     def note_spans_dropped(self, n: int = 1) -> None:
         with self._lock:
@@ -354,6 +391,10 @@ class DeviceStats:
                 "batches_coalesced_total": self._batches_coalesced,
                 "fire_merge_rows_read": self._fire_merge_rows,
                 "chain_fused_dispatches_total": self._chain_dispatches,
+                "rescales_total": self._rescales,
+                "keygroups_migrated_total": self._keygroups_migrated,
+                "rescale_bytes_moved_total": self._rescale_bytes_moved,
+                "rescale_ms": round(self._rescale_ms, 3),
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -404,6 +445,10 @@ class DeviceStats:
             self._batches_coalesced = 0
             self._fire_merge_rows = 0
             self._chain_dispatches = 0
+            self._rescales = 0
+            self._keygroups_migrated = 0
+            self._rescale_bytes_moved = 0
+            self._rescale_ms = 0.0
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -618,3 +663,11 @@ def bind_device_metrics(registry) -> None:
     # whole-chain fusion (prometheus:
     # flink_tpu_device_chain_fused_dispatches_total)
     g.gauge("chain_fused_dispatches_total", lambda: s.chain_dispatches)
+    # live rescale (prometheus: flink_tpu_device_rescales_total /
+    # flink_tpu_device_keygroups_migrated_total /
+    # flink_tpu_device_rescale_bytes_moved_total /
+    # flink_tpu_device_rescale_ms)
+    g.gauge("rescales_total", lambda: s.rescales)
+    g.gauge("keygroups_migrated_total", lambda: s.keygroups_migrated)
+    g.gauge("rescale_bytes_moved_total", lambda: s.rescale_bytes_moved)
+    g.gauge("rescale_ms", lambda: s.rescale_ms)

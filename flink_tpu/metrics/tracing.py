@@ -504,6 +504,15 @@ SPAN_INVENTORY: tuple = (
      "cluster/transport.py — zombie producer fenced by epoch check"),
     ("net", "Reconnect",
      "cluster/transport.py — severed data channel redial + replay"),
+    ("rescale", "Migrate",
+     "runtime/operators/mesh_window.py rescale_live — page ownership "
+     "diff + digest-verified key-group transfer"),
+    ("rescale", "Rebuild",
+     "runtime/operators/mesh_window.py rescale_live — state install on "
+     "the new mesh + derived-plane invalidation"),
+    ("rescale", "Rescale",
+     "cluster/local.py live_rescale + mesh_window rescale_live — root "
+     "span, barrier-aligned worker-set change without restart"),
     ("restart", "JobRestart",
      "cluster/scheduler.py + cluster/distributed.py _do_restart — "
      "full-job restart from last verified checkpoint"),

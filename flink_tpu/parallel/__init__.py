@@ -2,16 +2,23 @@
 keyed-window aggregation (SURVEY.md §2.10 / §5.8 — the ICI-collective
 replacement for the reference's KeyGroupStreamPartitioner + Netty stack)."""
 
-from .exchange import keyby_exchange
+from .exchange import (ExchangePlan, bucket_capacity, exchange_round,
+                       keyby_exchange, plan_exchange)
 from .mesh import (DATA_AXIS, device_index_for_key_groups, hash_int64_device,
                    key_groups_device, make_mesh, murmur_mix_device,
                    shard_ranges)
+from .plan import (DECLARED_AXES, MESH_RUNTIME, AxisRule, ShardingPlan,
+                   parse_axis_rules)
+from .rescale import MigrationPlan, paginate_snapshot, plan_migration
 from .sharded_window import (AggDef, ShardedWindowAgg, ShardedWindowState,
                              global_topk)
 
 __all__ = [
     "DATA_AXIS", "make_mesh", "shard_ranges", "murmur_mix_device",
     "hash_int64_device", "key_groups_device", "device_index_for_key_groups",
-    "keyby_exchange", "AggDef", "ShardedWindowAgg", "ShardedWindowState",
-    "global_topk",
+    "keyby_exchange", "plan_exchange", "exchange_round", "ExchangePlan",
+    "bucket_capacity", "AggDef", "ShardedWindowAgg", "ShardedWindowState",
+    "global_topk", "ShardingPlan", "AxisRule", "parse_axis_rules",
+    "DECLARED_AXES", "MESH_RUNTIME", "MigrationPlan", "paginate_snapshot",
+    "plan_migration",
 ]

@@ -8,20 +8,36 @@ device buckets its local micro-batch by destination shard and a single
 synchronous, so backpressure collapses to admission control at ingestion
 (SURVEY.md §7 hard-parts).
 
-Shapes are static: each device sends a [n_dev, B] buffer (capacity B per
-destination — worst case the whole local batch hashes to one shard), so no
-record is ever dropped by the exchange itself; invalid (padding) rows are
-routed to a virtual overflow destination and vanish.
+Two shapes of the same exchange live here:
+
+* ``keyby_exchange`` — the worst-case-width form: each device sends a
+  [n_dev, B] buffer (capacity B per destination — the whole local batch
+  may hash to one shard), so ONE collective always suffices but every
+  receiver folds n_dev*B rows. Per-device cost grows linearly with the
+  mesh, which is exactly the anti-scaling the multichip bench exposed.
+* ``plan_exchange`` + ``exchange_round`` — the capacity-bounded form the
+  sharded window step uses: buckets are cut into rounds of ``cap`` rows
+  per destination and the step loops rounds until the DEEPEST bucket
+  across the mesh is drained (`lax.pmax` of the local round counts, so
+  every device runs the same trip count and the collectives stay
+  uniform). A uniform batch takes one round of ~B/n_dev-deep buckets —
+  per-device fold width stays O(B) as the mesh grows; a fully skewed
+  batch degrades to ceil(B/cap) rounds, the old worst case, but never
+  drops a record.
+
+Invalid (padding) rows are routed to a virtual overflow destination and
+vanish in both forms.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["keyby_exchange"]
+__all__ = ["keyby_exchange", "plan_exchange", "exchange_round",
+           "ExchangePlan"]
 
 
 def keyby_exchange(axis_name: str, n_dev: int, dest: jax.Array,
@@ -63,3 +79,83 @@ def keyby_exchange(axis_name: str, n_dev: int, dest: jax.Array,
     routed = jax.tree.map(
         lambda x: x.reshape((n_dev * B,) + x.shape[2:]), recv)
     return routed, recv_valid.reshape(n_dev * B)
+
+
+class ExchangePlan(NamedTuple):
+    """Routing plan for the capacity-bounded exchange (see module doc).
+
+    order:    [B] int32 — stable sort permutation grouping rows by dest
+    sd:       [B] int32 — destination of each ordered row (n_dev = padding)
+    rank:     [B] int32 — position of each ordered row within its bucket
+    n_rounds: []  int32 — LOCAL round count; `lax.pmax` it across the
+              axis before looping so every device runs the same trips
+    """
+    order: jax.Array
+    sd: jax.Array
+    rank: jax.Array
+    n_rounds: jax.Array
+
+
+def bucket_capacity(batch: int, n_dev: int) -> int:
+    """Static per-destination round capacity for a local batch of `batch`.
+
+    Mean bucket depth is batch/n_dev; the +25% (floor +16) headroom keeps
+    a uniformly keyed batch to one round with high probability while a
+    skewed batch just takes more rounds — capacity never loses records.
+    """
+    per = -(-batch // n_dev)
+    return int(min(batch, max(32, per + max(per // 4, 16))))
+
+
+def plan_exchange(dest: jax.Array, valid: jax.Array, n_dev: int,
+                  cap: int) -> ExchangePlan:
+    """Bucket a local batch by destination for round-based exchange.
+
+    Call INSIDE shard_map. `cap` must be a static int (shapes depend on
+    it); `bucket_capacity` picks a good default.
+    """
+    B = dest.shape[0]
+    d = jnp.where(valid, dest, jnp.int32(n_dev))
+    order = jnp.argsort(d, stable=True)
+    sd = d[order]
+    counts = jnp.sum(jax.nn.one_hot(d, n_dev + 1, dtype=jnp.int32), axis=0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    rank = jnp.arange(B, dtype=jnp.int32) - offsets[sd]
+    deepest = jnp.max(counts[:n_dev])
+    n_rounds = (deepest + jnp.int32(cap - 1)) // jnp.int32(cap)
+    return ExchangePlan(order, sd, rank, n_rounds)
+
+
+def exchange_round(axis_name: str, n_dev: int, cap: int, plan: ExchangePlan,
+                   ordered_payload: Any, r: jax.Array) -> tuple[Any, jax.Array]:
+    """Route round `r` of a planned exchange: rows with bucket rank in
+    [r*cap, (r+1)*cap). `ordered_payload` columns must already be permuted
+    by `plan.order`. Returns ([n_dev*cap, ...] routed pytree, [n_dev*cap]
+    valid mask). Safe inside lax.while_loop with a pmax-uniform trip count.
+    """
+    sub = plan.rank - r * jnp.int32(cap)
+    in_round = (sub >= 0) & (sub < cap) & (plan.sd < n_dev)
+    # Out-of-round rows get an out-of-bounds slot so mode="drop" discards
+    # them (negative indices would wrap under the default mode).
+    slot = jnp.where(in_round, sub, jnp.int32(cap))
+
+    send_valid = jnp.zeros((n_dev, cap), bool).at[plan.sd, slot].set(
+        in_round, mode="drop")
+
+    def scatter(col):
+        buf = jnp.zeros((n_dev, cap) + col.shape[1:], col.dtype)
+        return buf.at[plan.sd, slot].set(col, mode="drop")
+
+    send = jax.tree.map(scatter, ordered_payload)
+    if n_dev == 1:
+        recv, recv_valid = send, send_valid
+    else:
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, axis_name, split_axis=0,
+                                         concat_axis=0), send)
+        recv_valid = jax.lax.all_to_all(send_valid, axis_name, split_axis=0,
+                                        concat_axis=0)
+    routed = jax.tree.map(
+        lambda x: x.reshape((n_dev * cap,) + x.shape[2:]), recv)
+    return routed, recv_valid.reshape(n_dev * cap)

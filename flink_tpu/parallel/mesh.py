@@ -49,8 +49,26 @@ def shard_ranges(max_parallelism: int, n_devices: int,
     across host subtasks over DCN (standard operator-index math), and each
     host's local mesh re-partitions its subtask range across its devices
     over ICI, with the same reference rounding rules applied in local
-    coordinates."""
+    coordinates.
+
+    Remainder handling (max_parallelism % n_devices != 0): the reference
+    rounding (KeyGroupRangeAssignment.java:computeKeyGroupRangeForOperatorIndex)
+    gives device i the range [ceil(i*MP/n), floor(((i+1)*MP - 1)/n)], so
+    consecutive ranges are CONTIGUOUS (next start = previous end + 1) and
+    together cover [0, MP) exactly, with sizes differing by at most one —
+    never an even-split truncation that would orphan the last MP % n key
+    groups. The same holds in local coordinates under ``base``. Both
+    invariants, plus agreement with device_index_for_key_groups routing,
+    are pinned by the property test in tests/test_parallel.py. Every range
+    must be non-empty, so n_devices may not exceed the (base) key-group
+    count — that is a configuration error reported here rather than an
+    opaque KeyGroupRange validation failure."""
     if base is None:
+        if max_parallelism < n_devices:
+            raise ValueError(
+                f"max_parallelism {max_parallelism} < {n_devices} devices "
+                f"leaves some devices without key groups; raise "
+                f"pipeline.max-parallelism or shrink the mesh")
         return [key_group_range_for_operator(max_parallelism, n_devices, i)
                 for i in range(n_devices)]
     length = base.end - base.start + 1

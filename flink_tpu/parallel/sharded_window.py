@@ -4,8 +4,9 @@ One compiled step per micro-batch over the WHOLE mesh (SURVEY.md §2.10
 data-parallelism row + §5.8): every device holds the keyed state for its
 contiguous key-group range (mesh.shard_ranges); a step is
 
-    key-group routing (murmur parity with the host)  ->
-    one `all_to_all` keyBy exchange over ICI          ->
+    key-group routing (murmur parity with the host)   ->
+    capacity-bounded `all_to_all` keyBy exchange over ICI
+    (one round for a uniform batch; skew adds rounds)  ->
     device hash-table lookup-or-insert per shard      ->
     one scatter-fold per aggregate into [ring, cap] pane accumulators
 
@@ -17,9 +18,23 @@ per-shard top-k then a tiny gather — the
 StreamExecLocal/GlobalGroupAggregate split.
 
 Everything here is functional: state is a pytree whose leaves carry a leading
-device axis sharded over the mesh's "data" axis, steps are jitted once, and
-the host only touches scalars (watermarks, pane boundaries) — the control
-plane of the DeviceWindowAggOperator, lifted to N chips.
+device axis sharded per the ShardingPlan's partition rules, steps compile
+through shard_map/pjit, and the host only touches scalars (watermarks, pane
+boundaries) — the control plane of the DeviceWindowAggOperator, lifted to N
+chips.
+
+Program caching (the rescale-critical invariant, JX505): every builder below
+is a module-level `instrumented_program_cache` keyed by
+``local_signature(aggs, capacity, ring)`` — the per-device shard shapes and
+dtypes, NEVER the device count or a global ``[D, ...]`` shape. All devices
+run the same SPMD program, so two meshes with equal local shards share one
+cache entry; a live rescale that preserves local shapes recompiles nothing
+(the step's key-group ownership bounds are traced arguments, not baked
+constants, so even re-pointing a mesh at a different subtask range is free).
+The step's shard_map program additionally binds per concrete Mesh inside its
+cache entry — changing the axis SIZE lowers new collectives once per size,
+while changing device identities or ownership at a fixed size re-dispatches
+the already-built program.
 """
 
 from __future__ import annotations
@@ -32,17 +47,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..metrics.device import instrumented_program_cache
 from ..ops.hash_table import EMPTY_KEY, ensure_x64, lookup_or_insert, \
     make_table
 from ..ops.segment_ops import AGG_COMBINE2, AGG_INITS, AGG_INVERT, \
     AGG_MERGES, INVERTIBLE_KINDS, make_accumulator, merge_tree_build, \
     merge_tree_update, pow2_ceil, scatter_fold
-from .exchange import keyby_exchange
+from .exchange import bucket_capacity, exchange_round, plan_exchange
 from .mesh import DATA_AXIS, device_index_for_key_groups, \
     key_groups_device, shard_ranges
+from .plan import ShardingPlan, match_partition_rules, shard_map_compat
 
 __all__ = ["AggDef", "ShardedWindowState", "ShardedWindowAgg",
-           "global_topk"]
+           "global_topk", "local_signature"]
 
 
 class AggDef(NamedTuple):
@@ -69,27 +86,365 @@ def _sanitize(keys: jax.Array) -> jax.Array:
                      jnp.int64(EMPTY_KEY) - 1, keys.astype(jnp.int64))
 
 
-class ShardedWindowAgg:
-    """Factory for the sharded step/fire/retire programs.
+# ----------------------------------------------------------------------
+# local-shard program-cache keys
+# ----------------------------------------------------------------------
 
-    Static config (mesh, aggregates, capacity, ring, max_parallelism) is
-    closed over so each program jits exactly once.
+def local_signature(aggs: Sequence[AggDef], capacity: int, ring: int
+                    ) -> tuple:
+    """The canonical program-cache key: aggregate schema + per-device
+    shard dims. Fully determines every local leaf — table [1, capacity]
+    int64, accs [1, ring, capacity] per dtype, dropped [1] int64 — and is
+    invariant under device count and mesh identity, which is what lets a
+    rescale hit every cached program (JX505 pins this contract)."""
+    return ("local",
+            tuple((a.name, a.kind, np.dtype(a.dtype).name) for a in aggs),
+            int(capacity), int(ring))
+
+
+def _aggs_from_sig(agg_sig) -> list[AggDef]:
+    return [AggDef(name, kind, np.dtype(dt)) for name, kind, dt in agg_sig]
+
+
+def _split_sig(agg_sig):
+    inv = tuple((kind, name) for name, kind, _ in agg_sig
+                if kind in INVERTIBLE_KINDS)
+    tree = tuple((kind, name) for name, kind, _ in agg_sig
+                 if kind not in INVERTIBLE_KINDS)
+    return inv, tree
+
+
+# ----------------------------------------------------------------------
+# module-level program builders (shared across instances and meshes)
+# ----------------------------------------------------------------------
+
+@instrumented_program_cache("mesh.step")
+def _step_program(sig, max_parallelism: int, axis_name: str,
+                  rules: tuple):
+    """The sharded fold step. The returned dispatcher takes the concrete
+    Mesh as its first argument and binds the shard_map program per mesh
+    inside this one cache entry: the cache key stays local-shape-only
+    while the executable still closes over the mesh jax 0.4.x requires."""
+    _, agg_sig, cap, ring = sig
+    aggs = _aggs_from_sig(agg_sig)
+    MP = max_parallelism
+
+    def bind(mesh: Mesh):
+        # lint: sync-ok mesh.devices is a host numpy array of Device objects
+        D = int(mesh.devices.size)
+
+        def shard_body(table, accs, dropped, keys, cols, panes, valid,
+                       base_start, base_len):
+            table, keys = table[0], keys[0]
+            accs = {k: v[0] for k, v in accs.items()}
+            cols = {k: v[0] for k, v in cols.items()}
+            panes, valid = panes[0], valid[0]
+
+            kg = key_groups_device(keys, MP)
+            # ownership bounds are TRACED scalars: a rescale that re-points
+            # this mesh at a different subtask range changes only argument
+            # values, never the program
+            dest = device_index_for_key_groups(kg, D, MP, base_start,
+                                               base_len)
+            # rows outside this subtask's range never fold (they belong to
+            # a peer host; a correct upstream exchange never sends them)
+            valid = valid & (dest >= 0) & (dest < D)
+            payload = {"__key__": _sanitize(keys), "__pane__": panes, **cols}
+
+            # capacity-bounded exchange: rounds of `cap_x` rows per
+            # destination keep the per-device fold width O(B) as the mesh
+            # grows (the worst-case-width keyby_exchange folds D*B rows
+            # per device — anti-scaling). The trip count is pmax-uniform
+            # across the axis so the collectives inside the loop line up;
+            # a skewed batch takes more rounds but never loses a record.
+            B = keys.shape[0]
+            cap_x = bucket_capacity(B, D)
+            xplan = plan_exchange(dest, valid, D, cap_x)
+            ordered = jax.tree.map(lambda c: c[xplan.order], payload)
+            n_rounds = jax.lax.pmax(xplan.n_rounds, axis_name)
+
+            def fold_round(carry):
+                r, table, accs, dropped, ok_count = carry
+                accs = dict(accs)
+                routed, rvalid = exchange_round(axis_name, D, cap_x, xplan,
+                                                ordered, r)
+                table, slots, ok = lookup_or_insert(
+                    table, routed["__key__"], rvalid)
+                n_dropped = jnp.sum(rvalid & ~ok).astype(jnp.int64)
+                ring_idx = jnp.where(ok, (routed["__pane__"] % ring),
+                                     0).astype(jnp.int32)
+                flat = ring_idx * cap + jnp.maximum(slots, 0)
+                for a in aggs:
+                    vals = (jnp.ones(flat.shape[0], a.dtype)
+                            if a.kind == "count" else routed[a.name])
+                    accs[a.name] = scatter_fold(
+                        a.kind, accs[a.name].reshape(-1), flat, vals,
+                        ok).reshape(ring, cap)
+                return (r + 1, table, accs, dropped + n_dropped,
+                        ok_count + jnp.sum(ok).astype(jnp.int64))
+
+            carry = (jnp.int32(0), table, accs, dropped,
+                     jnp.zeros((), jnp.int64))
+            _, table, accs, dropped, ok_count = jax.lax.while_loop(
+                lambda c: c[0] < n_rounds, fold_round, carry)
+            processed = jax.lax.psum(ok_count, axis_name)
+            return (table[None], {k: v[None] for k, v in accs.items()},
+                    dropped, processed)
+
+        skel = {"table": 0, "accs": {a.name: 0 for a in aggs},
+                "dropped": 0, "keys": 0,
+                "cols": {a.name: 0 for a in aggs if a.kind != "count"},
+                "panes": 0, "valid": 0}
+        sp = match_partition_rules(rules, skel)
+        state_specs = (sp["table"], sp["accs"], sp["dropped"])
+        mapped = shard_map_compat(
+            shard_body, mesh,
+            in_specs=state_specs + (sp["keys"], sp["cols"], sp["panes"],
+                                    sp["valid"], P(), P()),
+            out_specs=state_specs + (P(),))
+
+        @jax.jit
+        def step(state: ShardedWindowState, keys, cols, panes, valid,
+                 base_start, base_len):
+            table, accs, dropped, processed = mapped(
+                state.table, state.accs, state.dropped, keys, cols, panes,
+                valid, base_start, base_len)
+            return ShardedWindowState(table, accs, dropped), processed
+
+        return step
+
+    bound: dict = {}
+
+    def dispatch(mesh: Mesh, state, keys, cols, panes, valid,
+                 base_start, base_len):
+        prog = bound.get(mesh)
+        if prog is None:
+            prog = bound[mesh] = bind(mesh)
+        return prog(state, keys, cols, panes, valid, base_start, base_len)
+
+    return dispatch
+
+
+@instrumented_program_cache("mesh.fire")
+def _fire_program(sig):
+    _, agg_sig, _cap, _ring = sig
+    aggs = _aggs_from_sig(agg_sig)
+    count_name = next(name for name, kind, _ in agg_sig if kind == "count")
+
+    @jax.jit
+    def fire(state: ShardedWindowState, pane_rows: jax.Array,
+             rows_valid: jax.Array):
+        def merge(kind, arr):
+            sub = arr[:, pane_rows, :]              # [D, W, cap]
+            ident = AGG_INITS[kind](arr.dtype)
+            sub = jnp.where(rows_valid[None, :, None], sub, ident)
+            return AGG_MERGES[kind](sub, axis=1)
+
+        out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
+        count = out[count_name]
+        emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+        return out, emit
+
+    return fire
+
+
+@instrumented_program_cache("mesh.fire_full")
+def _fire_full_program(sig, rank_name: Optional[str], topk: Optional[int]):
+    """ONE compiled program for the whole fire (the mesh twin of
+    device_window._fire_program): pane merge for every aggregate + emit
+    mask + optional two-phase global top-k (per-shard lax.top_k, merge of
+    D*k candidates) + health scalars (max shard occupancy, total drops)
+    riding in the same outputs, so the hot loop never pays a separate sync
+    for pressure checks. Everything it returns is materialized with ONE
+    async device->host copy — never the full [D, capacity] table when a
+    top-k is requested."""
+    _, agg_sig, _cap, _ring = sig
+    aggs = _aggs_from_sig(agg_sig)
+    count_name = next(name for name, kind, _ in agg_sig if kind == "count")
+
+    @jax.jit
+    def fire(state: ShardedWindowState, pane_rows, rows_valid):
+        def merge(kind, arr):
+            sub = arr[:, pane_rows, :]              # [D, W, cap]
+            ident = AGG_INITS[kind](arr.dtype)
+            sub = jnp.where(rows_valid[None, :, None], sub, ident)
+            return AGG_MERGES[kind](sub, axis=1)
+
+        out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
+        count = out[count_name]
+        emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+        occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
+        dropped = state.dropped.sum()
+        if topk is None:
+            return state.table, emit, out, dropped, occ
+        rank = out[rank_name]
+        _vals, flat_idx, ok = global_topk(rank, emit, topk)
+        keys = jnp.take(state.table.reshape(-1), flat_idx)
+        res = {n: jnp.take(v.reshape(-1), flat_idx)
+               for n, v in out.items()}
+        return keys, ok, res, dropped, occ
+
+    return fire
+
+
+@instrumented_program_cache("mesh.seal_inc")
+def _seal_inc_program(sig):
+    """ONE donated program per pane seal: for each invertible plane,
+    window' = (window ⊕ sealed pane) ⊖ retiring pane; for each merge
+    tree, clear the retiring leaf then write the sealed pane and
+    recompute both O(log L) ancestor paths. Returns the fire view
+    ([D, capacity] per plane) alongside the new planes — the fire
+    consumes the view without re-reading any ring row."""
+    _, agg_sig, _cap, _ring = sig
+    inv_sig, tree_sig = _split_sig(agg_sig)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def seal(state: ShardedWindowState, wins: dict, trees: dict,
+             new_row, sub_row, sub_valid, new_leaf, old_leaf):
+        view, new_wins, new_trees = {}, {}, {}
+        for kind, name in inv_sig:
+            arr = state.accs[name]                  # [D, ring, cap]
+            sealed = jnp.take(arr, new_row, axis=1)  # [D, cap]
+            fire_v = AGG_COMBINE2[kind](wins[name], sealed)
+            ident = AGG_INITS[kind](arr.dtype)
+            retire = jnp.where(sub_valid,
+                               jnp.take(arr, sub_row, axis=1), ident)
+            new_wins[name] = AGG_INVERT[kind](fire_v, retire)
+            view[name] = fire_v
+        for kind, name in tree_sig:
+            arr = state.accs[name]
+            ident = jnp.full((arr.shape[0], arr.shape[2]),
+                             AGG_INITS[kind](arr.dtype), arr.dtype)
+            # clear the retiring leaf FIRST: it can never be the pane
+            # being sealed (any two live panes differ by < L)
+            tree = jax.vmap(
+                lambda t, v: merge_tree_update(kind, t, old_leaf, v)
+            )(trees[name], ident)
+            tree = jax.vmap(
+                lambda t, v: merge_tree_update(kind, t, new_leaf, v)
+            )(tree, jnp.take(arr, new_row, axis=1))
+            new_trees[name] = tree
+            view[name] = tree[:, 1]
+        return view, new_wins, new_trees
+
+    return seal
+
+
+@instrumented_program_cache("mesh.rebuild_inc")
+def _rebuild_inc_program(sig):
+    """Re-derive the incremental planes from the pane accumulators in
+    one dispatch (restore, degrade, fire-boundary jump, or a write
+    into an already-sealed pane). ``pane_rows``/``pane_leaves`` are
+    padded to [ring] so the program shape is window-width-independent;
+    padding rows carry leaf index L and drop out of the scatter."""
+    _, agg_sig, _cap, ring = sig
+    inv_sig, tree_sig = _split_sig(agg_sig)
+    L = pow2_ceil(ring)
+
+    @jax.jit
+    def rebuild(state: ShardedWindowState, pane_rows, rows_valid,
+                pane_leaves, sub_row, sub_valid):
+        view, new_wins, new_trees = {}, {}, {}
+        for kind, name in inv_sig:
+            arr = state.accs[name]
+            ident = AGG_INITS[kind](arr.dtype)
+            sub = jnp.where(rows_valid[None, :, None],
+                            arr[:, pane_rows, :], ident)
+            fire_v = AGG_MERGES[kind](sub, axis=1)   # [D, cap]
+            retire = jnp.where(sub_valid,
+                               jnp.take(arr, sub_row, axis=1), ident)
+            new_wins[name] = AGG_INVERT[kind](fire_v, retire)
+            view[name] = fire_v
+        for kind, name in tree_sig:
+            arr = state.accs[name]
+            ident = AGG_INITS[kind](arr.dtype)
+            rows = jnp.where(rows_valid[None, :, None],
+                             arr[:, pane_rows, :], ident)
+            leaves = jnp.full((arr.shape[0], L, arr.shape[2]), ident,
+                              arr.dtype)
+            idx = jnp.where(rows_valid, pane_leaves, L)
+            leaves = leaves.at[:, idx, :].set(rows, mode="drop")
+            tree = jax.vmap(lambda lv: merge_tree_build(kind, lv))(
+                leaves)
+            new_trees[name] = tree
+            view[name] = tree[:, 1]
+        return view, new_wins, new_trees
+
+    return rebuild
+
+
+@instrumented_program_cache("mesh.fire_inc")
+def _fire_inc_program(sig, rank_name: Optional[str], topk: Optional[int]):
+    """The fused fire over an incremental view: emit mask + optional
+    global top-k + health scalars — identical output structure to
+    _fire_full_program, but reading [D, capacity] views instead of
+    merging W ring rows."""
+    _, agg_sig, _cap, _ring = sig
+    count_name = next(name for name, kind, _ in agg_sig if kind == "count")
+
+    @jax.jit
+    def fire(state: ShardedWindowState, view: dict):
+        count = view[count_name]
+        emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
+        occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
+        dropped = state.dropped.sum()
+        if topk is None:
+            return state.table, emit, view, dropped, occ
+        rank = view[rank_name]
+        _vals, flat_idx, ok = global_topk(rank, emit, topk)
+        keys = jnp.take(state.table.reshape(-1), flat_idx)
+        res = {n: jnp.take(v.reshape(-1), flat_idx)
+               for n, v in view.items()}
+        return keys, ok, res, dropped, occ
+
+    return fire
+
+
+@instrumented_program_cache("mesh.retire")
+def _retire_program(sig):
+    _, agg_sig, _cap, _ring = sig
+    aggs = _aggs_from_sig(agg_sig)
+
+    @jax.jit
+    def retire(state: ShardedWindowState, row: jax.Array):
+        accs = {
+            a.name: state.accs[a.name].at[:, row].set(
+                AGG_INITS[a.kind](state.accs[a.name].dtype))
+            for a in aggs}
+        return state._replace(accs=accs)
+
+    return retire
+
+
+class ShardedWindowAgg:
+    """Facade over the cached sharded programs for one (mesh, schema).
+
+    Static schema (aggregates, capacity, ring) forms the local-shard
+    signature the module-level program caches key on; the mesh and the
+    key-group ownership are PER-INSTANCE runtime state — rebuilding an
+    instance on a new mesh (grow, restore, live rescale) with the same
+    signature reuses every already-compiled program.
     """
 
     def __init__(self, mesh: Mesh, aggs: Sequence[AggDef],
                  capacity: int = 1 << 16, ring: int = 64,
-                 max_parallelism: int = 128, base_range=None):
+                 max_parallelism: int = 128, base_range=None,
+                 plan: Optional[ShardingPlan] = None):
         """``base_range``: restrict this mesh to one SUBTASK's key-group
         range (multi-host deployment: the vertex is parallelized across
         hosts over DCN, each host's mesh owns its subtask range and
         re-shards it across local devices over ICI). None = full space
-        (single-host mesh vertex)."""
+        (single-host mesh vertex). ``plan``: partition rules + axis; by
+        default the configured MESH_RUNTIME rules over ``mesh``."""
         ensure_x64()
         if capacity & (capacity - 1):
             raise ValueError("capacity must be a power of two")
+        if plan is None:
+            from .plan import MESH_RUNTIME
+            plan = MESH_RUNTIME.plan(mesh)
+        self.plan = plan
         self.mesh = mesh
         self.n_dev = mesh.devices.size
-        self.base_range = base_range
         if max_parallelism < self.n_dev:
             raise ValueError("max_parallelism must be >= mesh size")
         self.aggs = list(aggs)
@@ -101,13 +456,7 @@ class ShardedWindowAgg:
         self.capacity = capacity
         self.ring = ring
         self.max_parallelism = max_parallelism
-        self.shard_ranges = shard_ranges(max_parallelism, self.n_dev,
-                                         base_range)
-        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
-        self._step = self._build_step()
-        self._fire = self._build_fire()
-        self._retire = self._build_retire()
-        self._fire_variants: dict = {}
+        self._sharding = plan.state_sharding
         # incremental fire engine plane split (window.fire.incremental):
         # invertible aggregates keep a running [D, capacity] window
         # accumulator; min/max keep a [D, 2L, capacity] binary merge tree
@@ -118,110 +467,54 @@ class ShardedWindowAgg:
                              if a.kind in INVERTIBLE_KINDS)
         self.tree_sig = tuple((a.kind, a.name) for a in self.aggs
                               if a.kind not in INVERTIBLE_KINDS)
+        self._step = _step_program(self.sig, max_parallelism,
+                                   plan.axis_name, plan.rules)
+        self._fire = _fire_program(self.sig)
+        self._retire = _retire_program(self.sig)
+        self.set_base_range(base_range)
+
+    # ------------------------------------------------------------------
+    def set_base_range(self, base_range) -> None:
+        """Re-point this mesh at a (new) subtask key-group range WITHOUT
+        recompiling: ownership bounds are traced step arguments, so a live
+        ownership change (key-group redistribution across an unchanged
+        worker set) only changes argument values."""
+        self.base_range = base_range
+        self.shard_ranges = shard_ranges(self.max_parallelism, self.n_dev,
+                                         base_range)
+        start = self.shard_ranges[0].start
+        self._base_start = np.int32(start)
+        self._base_len = np.int32(self.shard_ranges[-1].end - start + 1)
 
     # ------------------------------------------------------------------
     def init_state(self) -> ShardedWindowState:
         D, cap, ring = self.n_dev, self.capacity, self.ring
+        state = ShardedWindowState(
+            jnp.tile(make_table(cap)[None], (D, 1)),
+            {a.name: jnp.tile(
+                make_accumulator(a.kind, (ring, cap), a.dtype)[None],
+                (D, 1, 1)) for a in self.aggs},
+            jnp.zeros(D, jnp.int64))
         with self.mesh:
-            table = jax.device_put(
-                jnp.tile(make_table(cap)[None], (D, 1)), self._sharding)
-            accs = {
-                a.name: jax.device_put(
-                    jnp.tile(make_accumulator(a.kind, (ring, cap),
-                                              a.dtype)[None], (D, 1, 1)),
-                    self._sharding)
-                for a in self.aggs}
-            dropped = jax.device_put(jnp.zeros(D, jnp.int64), self._sharding)
-        return ShardedWindowState(table, accs, dropped)
+            return self.plan.device_put(state)
 
     # ------------------------------------------------------------------
-    def _build_step(self):
-        D, cap, ring = self.n_dev, self.capacity, self.ring
-        MP = self.max_parallelism
-        base_start = self.shard_ranges[0].start
-        base_len = (self.shard_ranges[-1].end - base_start + 1)
-        aggs = self.aggs
+    @property
+    def sig(self):
+        """Local-shape program-cache key (JX505): per-device shard shapes
+        only — derived, so partially-constructed test doubles get it too."""
+        return local_signature(self.aggs, self.capacity, self.ring)
 
-        def shard_body(table, accs, dropped, keys, cols, panes, valid):
-            table, keys = table[0], keys[0]
-            accs = {k: v[0] for k, v in accs.items()}
-            cols = {k: v[0] for k, v in cols.items()}
-            panes, valid = panes[0], valid[0]
-
-            kg = key_groups_device(keys, MP)
-            dest = device_index_for_key_groups(kg, D, MP, base_start,
-                                               base_len)
-            # rows outside this subtask's range never fold (they belong to
-            # a peer host; a correct upstream exchange never sends them)
-            valid = valid & (dest >= 0) & (dest < D)
-            payload = {"__key__": _sanitize(keys), "__pane__": panes, **cols}
-            routed, rvalid = keyby_exchange(DATA_AXIS, D, dest, payload,
-                                            valid)
-            table, slots, ok = lookup_or_insert(table, routed["__key__"],
-                                                rvalid)
-            n_dropped = jnp.sum(rvalid & ~ok).astype(jnp.int64)
-            ring_idx = jnp.where(ok, (routed["__pane__"] % ring), 0).astype(
-                jnp.int32)
-            flat = ring_idx * cap + jnp.maximum(slots, 0)
-            for a in aggs:
-                vals = (jnp.ones(flat.shape[0], a.dtype)
-                        if a.kind == "count" else routed[a.name])
-                accs[a.name] = scatter_fold(
-                    a.kind, accs[a.name].reshape(-1), flat, vals,
-                    ok).reshape(ring, cap)
-            processed = jax.lax.psum(jnp.sum(ok).astype(jnp.int64),
-                                     DATA_AXIS)
-            return (table[None], {k: v[None] for k, v in accs.items()},
-                    dropped + n_dropped, processed)
-
-        spec = P(DATA_AXIS)
-        state_specs = (spec, {a.name: spec for a in aggs}, spec)
-        mapped = jax.shard_map(
-            shard_body, mesh=self.mesh,
-            in_specs=state_specs + (spec,
-                                    {a.name: spec for a in aggs
-                                     if a.kind != "count"},
-                                    spec, spec),
-            out_specs=state_specs + (P(),),
-            check_vma=False)
-
-        @jax.jit
-        def step(state: ShardedWindowState, keys, cols, panes, valid):
-            table, accs, dropped, processed = mapped(
-                state.table, state.accs, state.dropped, keys, cols, panes,
-                valid)
-            return ShardedWindowState(table, accs, dropped), processed
-
-        return step
-
+    # ------------------------------------------------------------------
     def step(self, state: ShardedWindowState, keys: jax.Array, cols: dict,
              panes: jax.Array, valid: jax.Array
              ) -> tuple[ShardedWindowState, jax.Array]:
         """Fold one micro-batch. keys/panes/valid: [D, B]; cols: dict of
         [D, B] value columns (one per non-count aggregate)."""
-        return self._step(state, keys, cols, panes, valid)
+        return self._step(self.mesh, state, keys, cols, panes, valid,
+                          self._base_start, self._base_len)
 
     # ------------------------------------------------------------------
-    def _build_fire(self):
-        aggs = self.aggs
-        count_name = next(a.name for a in aggs if a.kind == "count")
-
-        @jax.jit
-        def fire(state: ShardedWindowState, pane_rows: jax.Array,
-                 rows_valid: jax.Array):
-            def merge(kind, arr):
-                sub = arr[:, pane_rows, :]              # [D, W, cap]
-                ident = AGG_INITS[kind](arr.dtype)
-                sub = jnp.where(rows_valid[None, :, None], sub, ident)
-                return AGG_MERGES[kind](sub, axis=1)
-
-            out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
-            count = out[count_name]
-            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
-            return out, emit
-
-        return fire
-
     def fire(self, state: ShardedWindowState, pane_rows: np.ndarray,
              rows_valid: Optional[np.ndarray] = None
              ) -> tuple[dict, jax.Array]:
@@ -238,53 +531,7 @@ class ShardedWindowAgg:
     # ------------------------------------------------------------------
     def _fire_full_program(self, rank_name: Optional[str],
                            topk: Optional[int]):
-        # per-instance cache (module convention: _step/_fire built per
-        # instance) — an lru_cache on the method would pin replaced
-        # instances alive across _grow() rebuilds
-        key = (rank_name, topk)
-        cached = self._fire_variants.get(key)
-        if cached is not None:
-            return cached
-        prog = self._build_fire_full(rank_name, topk)
-        self._fire_variants[key] = prog
-        return prog
-
-    def _build_fire_full(self, rank_name: Optional[str],
-                         topk: Optional[int]):
-        """ONE compiled program for the whole fire (the mesh twin of
-        device_window._fire_program): pane merge for every aggregate +
-        emit mask + optional two-phase global top-k (per-shard lax.top_k,
-        merge of D*k candidates) + health scalars (max shard occupancy,
-        total drops) riding in the same outputs, so the hot loop never
-        pays a separate sync for pressure checks. Everything it returns is
-        materialized with ONE async device->host copy — never the full
-        [D, capacity] table when a top-k is requested."""
-        aggs = self.aggs
-        count_name = next(a.name for a in aggs if a.kind == "count")
-
-        @jax.jit
-        def fire(state: ShardedWindowState, pane_rows, rows_valid):
-            def merge(kind, arr):
-                sub = arr[:, pane_rows, :]              # [D, W, cap]
-                ident = AGG_INITS[kind](arr.dtype)
-                sub = jnp.where(rows_valid[None, :, None], sub, ident)
-                return AGG_MERGES[kind](sub, axis=1)
-
-            out = {a.name: merge(a.kind, state.accs[a.name]) for a in aggs}
-            count = out[count_name]
-            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
-            occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
-            dropped = state.dropped.sum()
-            if topk is None:
-                return state.table, emit, out, dropped, occ
-            rank = out[rank_name]
-            _vals, flat_idx, ok = global_topk(rank, emit, topk)
-            keys = jnp.take(state.table.reshape(-1), flat_idx)
-            res = {n: jnp.take(v.reshape(-1), flat_idx)
-                   for n, v in out.items()}
-            return keys, ok, res, dropped, occ
-
-        return fire
+        return _fire_full_program(self.sig, rank_name, topk)
 
     def fire_compact(self, state: ShardedWindowState, pane_rows: np.ndarray,
                      rows_valid: np.ndarray, rank_name: Optional[str],
@@ -296,123 +543,12 @@ class ShardedWindowAgg:
             jnp.asarray(rows_valid))
 
     # -- incremental fire engine ---------------------------------------
-    def _inc_program(self, tag: tuple, builder):
-        cached = self._fire_variants.get(tag)
-        if cached is None:
-            cached = builder()
-            self._fire_variants[tag] = cached
-        return cached
-
-    def _build_seal_inc(self):
-        """ONE donated program per pane seal: for each invertible plane,
-        window' = (window ⊕ sealed pane) ⊖ retiring pane; for each merge
-        tree, clear the retiring leaf then write the sealed pane and
-        recompute both O(log L) ancestor paths. Returns the fire view
-        ([D, capacity] per plane) alongside the new planes — the fire
-        consumes the view without re-reading any ring row."""
-        inv_sig, tree_sig = self.inv_sig, self.tree_sig
-
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def seal(state: ShardedWindowState, wins: dict, trees: dict,
-                 new_row, sub_row, sub_valid, new_leaf, old_leaf):
-            view, new_wins, new_trees = {}, {}, {}
-            for kind, name in inv_sig:
-                arr = state.accs[name]                  # [D, ring, cap]
-                sealed = jnp.take(arr, new_row, axis=1)  # [D, cap]
-                fire_v = AGG_COMBINE2[kind](wins[name], sealed)
-                ident = AGG_INITS[kind](arr.dtype)
-                retire = jnp.where(sub_valid,
-                                   jnp.take(arr, sub_row, axis=1), ident)
-                new_wins[name] = AGG_INVERT[kind](fire_v, retire)
-                view[name] = fire_v
-            for kind, name in tree_sig:
-                arr = state.accs[name]
-                ident = jnp.full((arr.shape[0], arr.shape[2]),
-                                 AGG_INITS[kind](arr.dtype), arr.dtype)
-                # clear the retiring leaf FIRST: it can never be the pane
-                # being sealed (any two live panes differ by < L)
-                tree = jax.vmap(
-                    lambda t, v: merge_tree_update(kind, t, old_leaf, v)
-                )(trees[name], ident)
-                tree = jax.vmap(
-                    lambda t, v: merge_tree_update(kind, t, new_leaf, v)
-                )(tree, jnp.take(arr, new_row, axis=1))
-                new_trees[name] = tree
-                view[name] = tree[:, 1]
-            return view, new_wins, new_trees
-
-        return seal
-
-    def _build_rebuild_inc(self):
-        """Re-derive the incremental planes from the pane accumulators in
-        one dispatch (restore, degrade, fire-boundary jump, or a write
-        into an already-sealed pane). ``pane_rows``/``pane_leaves`` are
-        padded to [ring] so the program shape is window-width-independent;
-        padding rows carry leaf index L and drop out of the scatter."""
-        inv_sig, tree_sig, L = self.inv_sig, self.tree_sig, self.tree_size
-
-        @jax.jit
-        def rebuild(state: ShardedWindowState, pane_rows, rows_valid,
-                    pane_leaves, sub_row, sub_valid):
-            view, new_wins, new_trees = {}, {}, {}
-            for kind, name in inv_sig:
-                arr = state.accs[name]
-                ident = AGG_INITS[kind](arr.dtype)
-                sub = jnp.where(rows_valid[None, :, None],
-                                arr[:, pane_rows, :], ident)
-                fire_v = AGG_MERGES[kind](sub, axis=1)   # [D, cap]
-                retire = jnp.where(sub_valid,
-                                   jnp.take(arr, sub_row, axis=1), ident)
-                new_wins[name] = AGG_INVERT[kind](fire_v, retire)
-                view[name] = fire_v
-            for kind, name in tree_sig:
-                arr = state.accs[name]
-                ident = AGG_INITS[kind](arr.dtype)
-                rows = jnp.where(rows_valid[None, :, None],
-                                 arr[:, pane_rows, :], ident)
-                leaves = jnp.full((arr.shape[0], L, arr.shape[2]), ident,
-                                  arr.dtype)
-                idx = jnp.where(rows_valid, pane_leaves, L)
-                leaves = leaves.at[:, idx, :].set(rows, mode="drop")
-                tree = jax.vmap(lambda lv: merge_tree_build(kind, lv))(
-                    leaves)
-                new_trees[name] = tree
-                view[name] = tree[:, 1]
-            return view, new_wins, new_trees
-
-        return rebuild
-
-    def _build_fire_inc(self, rank_name: Optional[str],
-                        topk: Optional[int]):
-        """The fused fire over an incremental view: emit mask + optional
-        global top-k + health scalars — identical output structure to
-        _build_fire_full, but reading [D, capacity] views instead of
-        merging W ring rows."""
-        count_name = next(a.name for a in self.aggs if a.kind == "count")
-
-        @jax.jit
-        def fire(state: ShardedWindowState, view: dict):
-            count = view[count_name]
-            emit = (state.table != jnp.int64(EMPTY_KEY)) & (count > 0)
-            occ = (state.table != jnp.int64(EMPTY_KEY)).sum(axis=1).max()
-            dropped = state.dropped.sum()
-            if topk is None:
-                return state.table, emit, view, dropped, occ
-            rank = view[rank_name]
-            _vals, flat_idx, ok = global_topk(rank, emit, topk)
-            keys = jnp.take(state.table.reshape(-1), flat_idx)
-            res = {n: jnp.take(v.reshape(-1), flat_idx)
-                   for n, v in view.items()}
-            return keys, ok, res, dropped, occ
-
-        return fire
-
     def seal_inc(self, state: ShardedWindowState, wins: dict, trees: dict,
                  new_row: int, sub_row: int, sub_valid: bool,
                  new_leaf: int, old_leaf: int):
         """Seal one pane into the incremental planes (wins/trees are
         donated) and return (fire view, new wins, new trees)."""
-        return self._inc_program(("inc_seal",), self._build_seal_inc)(
+        return _seal_inc_program(self.sig)(
             state, wins, trees, jnp.int32(new_row), jnp.int32(sub_row),
             jnp.bool_(sub_valid), jnp.int32(new_leaf), jnp.int32(old_leaf))
 
@@ -421,7 +557,7 @@ class ShardedWindowAgg:
                     sub_row: int, sub_valid: bool):
         """Rebuild the incremental planes from the pane accumulators;
         same return shape as seal_inc."""
-        return self._inc_program(("inc_rebuild",), self._build_rebuild_inc)(
+        return _rebuild_inc_program(self.sig)(
             state, jnp.asarray(pane_rows, jnp.int32),
             jnp.asarray(rows_valid), jnp.asarray(pane_leaves, jnp.int32),
             jnp.int32(sub_row), jnp.bool_(sub_valid))
@@ -430,24 +566,9 @@ class ShardedWindowAgg:
                  rank_name: Optional[str], topk: Optional[int]):
         """Dispatch the fused incremental fire; returns device outputs
         (same structure as fire_compact) without synchronizing."""
-        return self._inc_program(
-            ("inc_fire", rank_name, topk),
-            lambda: self._build_fire_inc(rank_name, topk))(state, view)
+        return _fire_inc_program(self.sig, rank_name, topk)(state, view)
 
     # ------------------------------------------------------------------
-    def _build_retire(self):
-        aggs = self.aggs
-
-        @jax.jit
-        def retire(state: ShardedWindowState, row: jax.Array):
-            accs = {
-                a.name: state.accs[a.name].at[:, row].set(
-                    AGG_INITS[a.kind](state.accs[a.name].dtype))
-                for a in aggs}
-            return state._replace(accs=accs)
-
-        return retire
-
     def retire_row(self, state: ShardedWindowState,
                    row: int) -> ShardedWindowState:
         """Reset one ring row across all shards (pane retirement)."""

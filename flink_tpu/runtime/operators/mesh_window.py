@@ -40,7 +40,8 @@ from ...core.keygroups import hash_batch, key_groups_for_hash_batch
 from ...core.records import RecordBatch, Schema
 from ...ops.hash_table import EMPTY_KEY, lookup_or_insert, make_table
 from ...ops.segment_ops import AGG_INITS, make_accumulator
-from ...parallel.mesh import make_mesh
+from ...metrics.device import DEVICE_STATS
+from ...parallel.mesh import make_mesh, shard_ranges
 from ...parallel.sharded_window import (
     AggDef, ShardedWindowAgg, ShardedWindowState,
 )
@@ -120,6 +121,13 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
 
         self._agg: Optional[ShardedWindowAgg] = None
         self._state: Optional[ShardedWindowState] = None
+        # live rescale (PR 12): a pending worker-set change applied at the
+        # next barrier-aligned quiescent point; the epoch fences the mesh
+        # generation the way the coordinator's execution epoch fences
+        # restarts
+        self._rescale_request: Optional[int] = None
+        self._rescale_epoch = 0
+        self._last_rescale_stats: Optional[dict] = None
         self._init_control_plane()
         self._init_async_fires()
         if self._async:
@@ -166,6 +174,8 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
         # deterministic disjoint slices. On a real multi-host slice each
         # process only sees its own chips and takes them all.
         sub = ctx.subtask_index
+        self._parallelism = P
+        self._sub_index = sub
         if P > 1 and len(local) >= (sub + 1) * n:
             devs = local[sub * n:(sub + 1) * n]
         else:
@@ -532,8 +542,117 @@ class MeshWindowAggOperator(AsyncFireQueue, SliceControlPlane,
     def snapshot_state(self, checkpoint_id: int) -> dict:
         self._flush(pad=True)
         self._drain(block=True)
-        return {"keyed": {"backend": self._snapshot_backend(),
+        snap = {"keyed": {"backend": self._snapshot_backend(),
                           "meta": self._control_meta()}}
+        # coordinator-driven live rescale rides the aligned-barrier
+        # protocol: the snapshot above IS the consistent point (exactly
+        # the reference's savepoint-then-redistribute, minus the restart),
+        # so a pending worker-set change applies here, on the mailbox
+        # thread, with every buffered row folded and every fire drained
+        if self._rescale_request is not None:
+            req, self._rescale_request = self._rescale_request, None
+            self.rescale_live(req)
+        return snap
+
+    # -- live rescale -------------------------------------------------------
+    def request_rescale(self, n_devices: int) -> None:
+        """Stage a worker-set change; it applies at the next aligned
+        barrier (snapshot_state). Thread-safe: a single reference store,
+        read once on the mailbox thread."""
+        from ...parallel.plan import MESH_RUNTIME
+        if not MESH_RUNTIME.rescale_enabled:
+            raise RuntimeError(
+                "live rescale is disabled (mesh.rescale.enabled=false)")
+        self._rescale_request = int(n_devices)
+
+    def rescale_live(self, n_devices: Optional[int] = None,
+                     devices: Optional[Sequence] = None) -> dict:
+        """Re-shard device-resident key-group state across a new mesh
+        WITHOUT restarting the job: snapshot at the quiescent point, diff
+        key-group ownership old->new, ship only the pages whose groups
+        change owner (checkpoint page format, digest-verified), install on
+        the new mesh, and rebuild the derived incremental planes
+        (`role="window"` — never shipped). Emits one causal trace tree
+        under the ``rescale/`` scope and feeds the migration counters.
+
+        Because every sharded program is cache-keyed by local shard shape
+        only (sharded_window.local_signature), a rescale that preserves
+        per-device capacity/ring recompiles nothing."""
+        from ...metrics.tracing import TRACER
+        from ...parallel.rescale import plan_migration, reassemble_pages
+        t0 = time.perf_counter()
+        old_n = self._n_devices
+        local = list(devices) if devices is not None else jax.devices()
+        n = int(n_devices) if n_devices else len(local)
+        base_len = (self._max_parallelism if self._base_range is None
+                    else self._base_range.end - self._base_range.start + 1)
+        if base_len < n:
+            raise ValueError(
+                f"subtask key-group range ({base_len} groups) must be >= "
+                f"new mesh size ({n}); raise pipeline.max-parallelism")
+        P = getattr(self, "_parallelism", 1)
+        sub = getattr(self, "_sub_index", 0)
+        if P > 1 and len(local) >= (sub + 1) * n:
+            devs = local[sub * n:(sub + 1) * n]
+        else:
+            devs = local[:n]
+        if self._agg is None:
+            # nothing materialized yet: adopt the new worker set directly
+            self._n_devices = n
+            self._mesh = make_mesh(n, devices=devs)
+            self._rescale_epoch += 1
+            self._last_rescale_stats = {
+                "old_devices": old_n, "new_devices": n,
+                "keygroups_migrated": 0, "bytes_moved": 0,
+                "epoch": self._rescale_epoch, "duration_ms": 0.0}
+            return self._last_rescale_stats
+        with TRACER.span("rescale", "Rescale") as root:
+            root.set_attribute("old_devices", old_n)
+            root.set_attribute("new_devices", n)
+            # quiescent point: every buffered row folded, every async fire
+            # drained — the operator-local equivalent of barrier alignment
+            self._flush(pad=True)
+            self._drain(block=True)
+            old_sig = self._agg.sig
+            old_ranges = tuple(self._agg.shard_ranges)
+            new_ranges = tuple(shard_ranges(self._max_parallelism, n,
+                                            self._base_range))
+            snap = self._snapshot_backend()
+            with TRACER.span("rescale", "Migrate") as mig:
+                plan = plan_migration(snap, old_ranges, new_ranges)
+                verified = reassemble_pages(plan.pages, snap)
+                mig.set_attribute("keygroups_migrated",
+                                  plan.keygroups_migrated)
+                mig.set_attribute("bytes_moved", plan.bytes_moved)
+                mig.set_attribute("pages_moved", len(plan.moved_pages))
+            with TRACER.span("rescale", "Rebuild") as reb:
+                self._n_devices = n
+                self._mesh = make_mesh(n, devices=devs)
+                # never shrink per-shard capacity on rescale: keeping the
+                # local shard signature stable is what lets the program
+                # caches hit (recompiles == 0 across the switch)
+                self._capacity = max(self._capacity, self._agg.capacity)
+                if len(verified["keys"]) or verified["states"]:
+                    self._restore_backends([verified])
+                else:
+                    self._build(list(self._agg.aggs),
+                                capacity=self._agg.capacity)
+                # derived incremental planes are rebuilt, never shipped
+                self._mark_inc_dirty()
+                reb.set_attribute("local_shapes_changed",
+                                  self._agg.sig != old_sig)
+            self._rescale_epoch += 1
+            root.set_attribute("epoch", self._rescale_epoch)
+        duration_ms = (time.perf_counter() - t0) * 1e3
+        DEVICE_STATS.note_rescale(plan.keygroups_migrated,
+                                  plan.bytes_moved, duration_ms)
+        self._last_rescale_stats = {
+            "old_devices": old_n, "new_devices": n,
+            "keygroups_migrated": plan.keygroups_migrated,
+            "bytes_moved": plan.bytes_moved,
+            "epoch": self._rescale_epoch,
+            "duration_ms": duration_ms}
+        return self._last_rescale_stats
 
     def _live_pane_span(self) -> range:
         """Panes whose ring rows may hold live data (everything below has

@@ -347,6 +347,86 @@ def test_seeded_value_keyed_cache_detected(_audit_registry):
         ("JX504", "seeded.step:value-keyed")]
 
 
+def test_seeded_mesh_nonlocal_keys_detected(_audit_registry):
+    """JX505: a mesh-scoped program whose build key is not the local
+    signature, and one whose key embeds a global [D, ...] dispatch shape;
+    the local-signature-keyed twin is clean."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    sds = jax.ShapeDtypeStruct((8, 64), jnp.float32)  # a [D, B] dispatch
+    _seed_program(_audit_registry, "mesh.badkey", f, sds,
+                  build_key="((8, 64), 128)")
+    _seed_program(_audit_registry, "mesh.badshape", f, sds,
+                  build_key="((('local', ()), '(8, 64)'), ())")
+    findings = run_rules(AnalysisContext(), ["JX505"])
+    assert {(x.rule, x.symbol) for x in findings} == {
+        ("JX505", "mesh.badkey:not-local-keyed"),
+        ("JX505", "mesh.badshape:global-shape-keyed")}
+
+    _audit_registry.clear()
+    _seed_program(
+        _audit_registry, "mesh.step", f, sds,
+        build_key="((('local', (('price', 'sum', 'int64'),), 256, 8), "
+                  "128, 'data'), ())")
+    assert run_rules(AnalysisContext(), ["JX505"]) == []
+
+
+def test_real_mesh_programs_are_local_keyed(_audit_registry):
+    """The shipped sharded-window builders pass JX505 when exercised on a
+    real (virtual) mesh — the contract live rescale depends on."""
+    import jax
+    import jax.numpy as jnp
+    from flink_tpu.parallel import AggDef, ShardedWindowAgg, make_mesh
+    jax.config.update("jax_enable_x64", True)
+
+    D = max(1, min(4, len(jax.devices())))
+    # a signature no other test builds, so the program caches MISS and
+    # fresh audit entries land in the cleared registry
+    agg = ShardedWindowAgg(make_mesh(D), [AggDef("price", "sum", jnp.int64)],
+                           capacity=512, ring=4, max_parallelism=128)
+    state = agg.init_state()
+    B = 64
+    keys = (jnp.arange(D * B, dtype=jnp.int64) % 37).reshape(D, B) + 1
+    agg.step(state, keys, {"price": jnp.ones((D, B), jnp.int64)},
+             jnp.zeros((D, B), jnp.int32), jnp.ones((D, B), bool))
+    assert any(e.scope.startswith("mesh.") for e in _audit_registry)
+    assert run_rules(AnalysisContext(), ["JX505"]) == []
+
+
+def test_seeded_undeclared_collective_axis_detected(tmp_path):
+    """TPU102: collectives naming an axis outside DECLARED_AXES are
+    flagged; the declared-axis and threaded-axis_name forms, plus a
+    reasoned 'axis-ok' suppression, are clean."""
+    ctx = _mini_pkg(tmp_path, {
+        "parallel/mesh.py": 'DATA_AXIS = "data"\n',
+        "parallel/plan.py": ('from .mesh import DATA_AXIS\n'
+                             'DECLARED_AXES = (DATA_AXIS,)\n'),
+        "hot.py": '''
+            import jax
+            from jax import lax
+
+            def good(x, axis_name):
+                a = jax.lax.psum(x, "data")
+                b = lax.all_to_all(x, axis_name, split_axis=0,
+                                   concat_axis=0)
+                return a + b
+
+            def waived(x):
+                return jax.lax.pmax(x, "adhoc")  # lint: axis-ok seeded
+
+            def bad(x):
+                y = jax.lax.psum(x, "rows")
+                i = jax.lax.axis_index("cols")
+                return y + i
+        ''',
+    })
+    findings = run_rules(ctx, ["TPU102"])
+    assert sorted(f.symbol.split(":")[0] for f in findings) == ["bad", "bad"]
+    assert {f.rule for f in findings} == {"TPU102"}
+
+
 # ---------------------------------------------------------------------------
 # Framework mechanics: fingerprints, baseline diff, suppression hygiene
 
