@@ -227,6 +227,13 @@ def _collect_metrics(env, before: dict) -> dict:
     for k in ("panes_sealed_total", "batches_coalesced_total",
               "fire_merge_rows_read", "chain_fused_dispatches_total"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
+    # tiered-state counters: eviction/prefetch deltas for this run plus
+    # the hit-ratio and HBM-footprint gauges (point-in-time readings)
+    for k in ("tier_evictions_total", "tier_evicted_keys_total",
+              "tier_prefetches_total", "tier_promoted_keys_total"):
+        out[k] = snap.get(k, 0) - before.get(k, 0)
+    for k in ("tier_hot_hit_ratio", "tier_hbm_bytes_in_use"):
+        out[k] = snap.get(k, 0)
     for k in ("device_retries_total", "device_degraded_total",
               "dead_letter_records_total", "injected_faults_total",
               "watchdog_trips_total", "stall_detections_total",
@@ -360,6 +367,10 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
     under chaos (retried compiles legitimately recount)."""
     n_events = n_batches * batch
     extra = dict(extra_config) if extra_config else None
+    # warmup must compile the TIMED run's programs (e.g. the HBM-budget
+    # capacity cap changes table/plane shapes), so it runs under the
+    # caller's config — but never under the chaos schedule
+    warm_extra = dict(extra) if extra else None
     if chaos_seed is not None:
         extra = dict(extra or {})
         extra.update(
@@ -375,7 +386,8 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
         FAULTS.reset()  # arm fresh: visit counters start at zero
         WATCHDOG.reset()
     _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
-            metrics_registry=metrics_registry, fire_mode=fire_mode,
+            metrics_registry=metrics_registry, extra_config=warm_extra,
+            fire_mode=fire_mode,
             window_panes=window_panes)                      # compile warmup
     wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
                                       batch=batch,
@@ -411,7 +423,11 @@ CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
               "transfer.h2d=p0.05,transfer.d2h=every@5!hang@30,"
               "channel.send=once@3,channel.backpressure=every@17,"
               "checkpoint.write=once@1,sink.invoke=once@2,"
-              "rpc.heartbeat=every@5,net.sever=every@23")
+              "rpc.heartbeat=every@5,net.sever=every@23,"
+              # tiered-state sites: no-ops unless the run sets an HBM
+              # budget (--tiered does; mid-window evict/prefetch parity
+              # is asserted exactly in tests/test_tiering.py)
+              "tier.evict=once@2,tier.prefetch=once@2")
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -1338,6 +1354,72 @@ def chaos(seed: int) -> None:
     sys.stdout.flush()
 
 
+def tiered(budget_slots: int = 1 << 10, batch: int = 1 << 12,
+           n_batches: int = 8) -> None:
+    """`python bench.py --tiered`: key-cardinality sweep of the tiny Q5
+    stage under a FIXED HBM budget (`state.backend.tpu.hbm-budget-slots`
+    = 1024): 1x / 10x / 100x the budget-resident key count, so the 100x
+    point runs with ~99% of keys host-warm. One JSON line per point with
+    events/sec, the recompile count (must stay 0 — residency changes
+    never retrace), and the tier counters (evictions, prefetches, hot
+    hit ratio, HBM bytes). The acceptance bar: the 100x point holds
+    within 2x of the ALL-RESIDENT baseline at the same cardinality.
+    Results land in TIERED_rXX.json."""
+    probe = _ensure_backend()
+    _emit_probe(probe)
+    base_keys = budget_slots // 2  # resident working set incl. headroom
+    rec = {"metric": "nexmark_q5_tiered_sweep", "unit": "report",
+           "budget_slots": budget_slots, "base_keys": base_keys,
+           "points": {}}
+    for mult in (1, 10, 100):
+        n_keys = base_keys * mult
+        stages = run_tiny_q5(
+            n_keys=n_keys, batch=batch, n_batches=n_batches,
+            extra_config={
+                "state.backend.tpu.hbm-budget-slots": budget_slots,
+                # residency changes apply at watermark boundaries; the
+                # tiny stage finishes in well under the default 200ms
+                # watermark interval, so tighten it to give the prefetch
+                # pipeline boundaries to stage + apply promotions at
+                "pipeline.auto-watermark-interval": 0.005})
+        point = {"n_keys": n_keys,
+                 "events_per_sec": stages["events_per_sec"],
+                 "recompiles": stages.get("recompiles", 0),
+                 "tier_evictions": stages.get("tier_evictions_total", 0),
+                 "tier_prefetches": stages.get("tier_prefetches_total", 0),
+                 "tier_hot_hit_ratio": stages.get("tier_hot_hit_ratio", 0),
+                 "tier_hbm_bytes": stages.get("tier_hbm_bytes_in_use", 0)}
+        rec["points"][f"{mult}x"] = point
+        print(json.dumps({"metric": "nexmark_q5_tiered_point",
+                          "unit": "events/sec", **point}))
+        sys.stdout.flush()
+    # all-resident baseline at the 100x cardinality (no budget): the
+    # tiered run must hold >= 0.5x of this rate
+    baseline = run_tiny_q5(n_keys=base_keys * 100, batch=batch,
+                           n_batches=n_batches)
+    rec["baseline_events_per_sec"] = baseline["events_per_sec"]
+    eps100 = rec["points"]["100x"]["events_per_sec"]
+    rec["ratio_100x_vs_all_resident"] = round(
+        eps100 / baseline["events_per_sec"], 4)
+    rec["within_2x"] = rec["ratio_100x_vs_all_resident"] >= 0.5
+    import glob
+    import re
+    rounds = [int(m.group(1)) for f in glob.glob("TIERED_r*.json")
+              for m in [re.search(r"_r(\d+)\.json$", f)] if m]
+    path = f"TIERED_r{max(rounds, default=0) + 1:02d}.json"
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"metric": "nexmark_q5_tiered_report",
+                      "unit": "report", "path": path,
+                      "baseline_events_per_sec":
+                          rec["baseline_events_per_sec"],
+                      "ratio_100x_vs_all_resident":
+                          rec["ratio_100x_vs_all_resident"],
+                      "within_2x": rec["within_2x"]}))
+    sys.stdout.flush()
+
+
 if __name__ == "__main__":
     if "--trace" in sys.argv:
         i = sys.argv.index("--trace")
@@ -1384,6 +1466,8 @@ if __name__ == "__main__":
         # audit alone: the tiny acceptance probe with the jaxpr audit on
         tiny(fire_mode=_fire_mode, window_panes_list=_window_panes,
              audit=True)
+    elif "--tiered" in sys.argv:
+        tiered()
     elif "--chaos" in sys.argv:
         i = sys.argv.index("--chaos")
         chaos(int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 0)

@@ -109,6 +109,12 @@ class AnalysisSettings:
         "runtime/stream_task.py",
         "sql/device_group_agg.py",
         "parallel/sharded_window.py",
+        # tiered-state residency (ISSUE 15): policy/manager/pipeline must
+        # stay host-sync-free — the backend hands them plain numpy and
+        # applies their decisions on device itself
+        "state/tiering/policy.py",
+        "state/tiering/residency.py",
+        "state/tiering/prefetch.py",
     )
     # Singleton-wiring rule: deploy entry points -> (module, qualname).
     # A class entry point means "somewhere in the class's transitive

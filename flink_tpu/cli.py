@@ -284,6 +284,42 @@ def _cmd_trace_dump(args) -> int:
     return 0
 
 
+def _cmd_state_residency(args) -> int:
+    """Print the per-key-group residency/heat table of a job's tiered
+    keyed state: which key groups are device-hot vs host-warm, their 2Q
+    stage, decayed heat, and last-touch batch. Fetches
+    ``/jobs/<name>/state-residency`` from a running endpoint, or falls
+    back to THIS process's residency registry when no ``--target`` is
+    given (useful right after an in-process run)."""
+    import json as _json
+    import urllib.request
+
+    if args.target:
+        url = f"http://{args.target}/jobs/{args.job}/state-residency"
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                payload = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"state-residency: cannot fetch {url}: {e}",
+                  file=sys.stderr)
+            return 1
+        rows = payload.get("rows", [])
+    else:
+        from .state.tiering import residency_table
+        rows = residency_table(args.job)
+    if not rows:
+        print("no tiered state registered (is the job running under "
+              "state.backend.tpu.hbm-budget-bytes / -slots?)")
+        return 0
+    warm = sum(1 for r in rows if r["tier"] == "warm")
+    cells = [[r["operator"], r["key_group"], r["tier"], r["stage"],
+              r["warm_keys"], r["heat"], r["last_touch"]] for r in rows]
+    _print_table(["operator", "key_group", "tier", "stage", "warm_keys",
+                  "heat", "last_touch"], cells, max_rows=args.max_rows)
+    print(f"{warm} warm / {len(rows) - warm} hot key group(s)")
+    return 0
+
+
 def _cmd_sql(args) -> int:
     """Interactive SQL client against a TableEnvironment (reference
     flink-table/flink-sql-client SqlClient.java:67): DDL mutates the
@@ -563,6 +599,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                           "printing a table")
     trd.add_argument("--max-rows", type=int, default=200)
     trd.set_defaults(fn=_cmd_trace_dump)
+
+    srr = sub.add_parser(
+        "state-residency",
+        help="print the per-key-group residency/heat table of a job's "
+             "tiered keyed state (device-hot vs host-warm)")
+    srr.add_argument("job", nargs="?", default="",
+                     help="job (or job/operator) name; empty = every "
+                          "registered operator")
+    srr.add_argument("--target", default="",
+                     help="host:port of a REST endpoint; empty = the "
+                          "current process's residency registry")
+    srr.add_argument("--max-rows", type=int, default=200)
+    srr.set_defaults(fn=_cmd_state_residency)
 
     gwp = sub.add_parser("sql-gateway",
                          help="serve the REST SQL gateway")

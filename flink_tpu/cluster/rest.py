@@ -148,6 +148,16 @@ class RestEndpoint:
         return {"name": name,
                 "spans": [s.to_dict() for s in TRACER.retained_spans()]}
 
+    def _state_residency(self, name: str) -> Optional[dict]:
+        """Per-key-group residency/heat rows of the job's tiered keyed
+        state (empty when no operator runs under an HBM budget). Rows
+        come from the process-global residency registry the budgeted
+        window operators register into at setup."""
+        if name not in self._jobs:
+            return None
+        from ..state.tiering import residency_table
+        return {"name": name, "rows": residency_table(name)}
+
     def _flight_recorder(self, name: str) -> Optional[dict]:
         """Post-mortem surface: the dump records written so far (stalls,
         restarts, corrupt artifacts, zombie fences) plus the live ring's
@@ -249,6 +259,11 @@ class RestEndpoint:
                     tr = endpoint._traces(parts[1])
                     self._reply(200 if tr else 404,
                                 tr or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "state-residency"):
+                    sr = endpoint._state_residency(parts[1])
+                    self._reply(200 if sr else 404,
+                                sr or {"error": "no such job"})
                 elif (len(parts) == 3 and parts[0] == "jobs"
                       and parts[2] == "flight-recorder"):
                     fr = endpoint._flight_recorder(parts[1])

@@ -89,6 +89,17 @@ class DeviceStats:
         self._keygroups_migrated = 0
         self._rescale_bytes_moved = 0
         self._rescale_ms = 0.0
+        # tiered-state accounting (PR 15): key groups demoted to the
+        # host-warm tier / promoted back, hot-tier touch ratio (accesses
+        # landing on device-resident groups over all accesses), and the
+        # latest HBM bytes held by the keyed-state planes
+        self._tier_evictions = 0
+        self._tier_evicted_keys = 0
+        self._tier_prefetches = 0
+        self._tier_promoted_keys = 0
+        self._tier_hot_touches = 0
+        self._tier_touches = 0
+        self._tier_hbm_bytes = 0
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -273,6 +284,46 @@ class DeviceStats:
         with self._lock:
             return self._rescale_ms
 
+    # -- tiered-state accounting ---------------------------------------------
+    def note_tier_eviction(self, groups: int, keys: int) -> None:
+        with self._lock:
+            self._tier_evictions += int(groups)
+            self._tier_evicted_keys += int(keys)
+
+    def note_tier_prefetch(self, groups: int, keys: int) -> None:
+        with self._lock:
+            self._tier_prefetches += int(groups)
+            self._tier_promoted_keys += int(keys)
+
+    def note_tier_touches(self, hot: int, total: int) -> None:
+        with self._lock:
+            self._tier_hot_touches += int(hot)
+            self._tier_touches += int(total)
+
+    def set_tier_hbm_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self._tier_hbm_bytes = int(nbytes)
+
+    @property
+    def tier_evictions(self) -> int:
+        with self._lock:
+            return self._tier_evictions
+
+    @property
+    def tier_prefetches(self) -> int:
+        with self._lock:
+            return self._tier_prefetches
+
+    @property
+    def tier_hot_hit_ratio(self) -> float:
+        with self._lock:
+            return self._tier_hot_touches / max(self._tier_touches, 1)
+
+    @property
+    def tier_hbm_bytes_in_use(self) -> int:
+        with self._lock:
+            return self._tier_hbm_bytes
+
     # -- tracing accounting --------------------------------------------------
     def note_spans_dropped(self, n: int = 1) -> None:
         with self._lock:
@@ -395,6 +446,13 @@ class DeviceStats:
                 "keygroups_migrated_total": self._keygroups_migrated,
                 "rescale_bytes_moved_total": self._rescale_bytes_moved,
                 "rescale_ms": round(self._rescale_ms, 3),
+                "tier_evictions_total": self._tier_evictions,
+                "tier_evicted_keys_total": self._tier_evicted_keys,
+                "tier_prefetches_total": self._tier_prefetches,
+                "tier_promoted_keys_total": self._tier_promoted_keys,
+                "tier_hot_hit_ratio": round(
+                    self._tier_hot_touches / max(self._tier_touches, 1), 6),
+                "tier_hbm_bytes_in_use": self._tier_hbm_bytes,
             }
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
@@ -449,6 +507,10 @@ class DeviceStats:
             self._keygroups_migrated = 0
             self._rescale_bytes_moved = 0
             self._rescale_ms = 0.0
+            self._tier_evictions = self._tier_evicted_keys = 0
+            self._tier_prefetches = self._tier_promoted_keys = 0
+            self._tier_hot_touches = self._tier_touches = 0
+            self._tier_hbm_bytes = 0
             self.dead_letter_records = self.dead_letter_batches = 0
             self.h2d_bytes = self.h2d_records = self.h2d_batches = 0
             self.d2h_bytes = self.d2h_records = self.d2h_fires = 0
@@ -671,3 +733,11 @@ def bind_device_metrics(registry) -> None:
     g.gauge("keygroups_migrated_total", lambda: s.keygroups_migrated)
     g.gauge("rescale_bytes_moved_total", lambda: s.rescale_bytes_moved)
     g.gauge("rescale_ms", lambda: s.rescale_ms)
+    # tiered state (prometheus: flink_tpu_device_tier_evictions_total /
+    # flink_tpu_device_tier_prefetches_total /
+    # flink_tpu_device_tier_hot_hit_ratio /
+    # flink_tpu_device_tier_hbm_bytes_in_use)
+    g.gauge("tier_evictions_total", lambda: s.tier_evictions)
+    g.gauge("tier_prefetches_total", lambda: s.tier_prefetches)
+    g.gauge("tier_hot_hit_ratio", lambda: s.tier_hot_hit_ratio)
+    g.gauge("tier_hbm_bytes_in_use", lambda: s.tier_hbm_bytes_in_use)

@@ -526,6 +526,12 @@ SPAN_INVENTORY: tuple = (
      "restore-candidate selection"),
     ("task", "SourceBatch",
      "runtime/stream_task.py — one source read→emit mailbox cycle"),
+    ("tier", "Evict",
+     "state/tpu_backend.py _evict_cold_groups — cold key groups paged "
+     "to the host-warm tier + device table rebuild"),
+    ("tier", "Prefetch",
+     "state/tiering/prefetch.py PrefetchPipeline — warm key groups "
+     "gathered + staged for promotion at a batch boundary"),
     ("watchdog", "Stall",
      "runtime/watchdog.py _note_trip — deadline expiry at a guarded "
      "site"),

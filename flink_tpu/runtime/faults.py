@@ -72,6 +72,7 @@ FAULT_SITES = (
     "checkpoint.write", "checkpoint.load",
     "checkpoint.corrupt", "checkpoint.truncate",
     "rpc.heartbeat", "rpc.send", "sink.invoke",
+    "tier.evict", "tier.prefetch",
     "bench.probe",
     "net.connect", "net.sever", "net.delay", "net.zombie",
 )

@@ -557,6 +557,20 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
             WindowOptions
         budget = self._hbm_budget or ctx.config.get(
             StateOptions.TPU_HBM_BUDGET)
+        if not budget:
+            # byte-denominated budget: convert to slots from the per-slot
+            # footprint this operator allocates — the 8-byte table key
+            # plus every [ring, capacity] accumulator plane row (count
+            # plane + one value plane per non-count aggregate, avg's sum
+            # plane included), at 8 bytes per cell (the widest dtype the
+            # planes use; narrower dtypes just land under budget)
+            budget_bytes = int(ctx.config.get(
+                StateOptions.TPU_HBM_BUDGET_BYTES) or 0)
+            if budget_bytes:
+                value_planes = sum(1 for a in self._aggs
+                                   if a.kind != "count")
+                slot_bytes = 8 + (self._ring or 1) * 8 * (1 + value_planes)
+                budget = max(1, budget_bytes // slot_bytes)
         if self._inc_flag is None:
             self._inc_enabled = bool(
                 ctx.config.get(WindowOptions.FIRE_INCREMENTAL))
@@ -579,8 +593,14 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
                       and self._fused_spec is None)
         self._backend = TpuKeyedStateBackend(
             ctx.key_group_range, ctx.max_parallelism,
-            capacity=self._capacity, defer_overflow=self._defer,
+            capacity=self._capacity, config=ctx.config,
+            defer_overflow=self._defer,
             hbm_budget_slots=budget, host_index=host_index)
+        if self._backend.tiering_active:
+            from ...state.tiering import register_residency
+            register_residency(
+                f"{ctx.task_name}/{ctx.subtask_index}",
+                self._backend.residency)
         # count-plane width follows the declared result bound: a COUNT
         # aggregate with value_bits <= 31 promises every per-window count
         # fits int32, which halves the fold scatter + fire merge traffic
@@ -806,6 +826,13 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
                 host_index=bool(self.ctx.config.get(
                     StateOptions.TPU_HOST_INDEX)))
             new_backend.restore([snap])
+        if self._backend.tiering_active:
+            # the fallback backend is unbudgeted: retire the residency
+            # registry entry (and any queued prefetch staging) with it
+            self._backend.prefetch_pipeline.cancel()
+            from ...state.tiering import unregister_residency
+            unregister_residency(
+                f"{self.ctx.task_name}/{self.ctx.subtask_index}")
         self._backend = new_backend
         self._defer = False
         self._stage = None
@@ -1023,8 +1050,20 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         semantics are unchanged by buffering), then deferred spill: staged
         host-tier rows must land before any fire merges host parts
         (exactly-once per window). One tiny scalar sync per watermark, a
-        buffer transfer only when something was staged."""
+        buffer transfer only when something was staged. Once nothing is
+        in flight for any group, the tiering boundary hook runs: heat
+        decay advances and at most one staged warm->hot promotion lands
+        (batch-boundary-only residency changes keep the fire path's
+        scatter-free invariants and exactly-once intact)."""
         self._coalesce_flush()
+        self._drain_spill_stage()
+        if self._backend is not None and self._backend.tiering_active:
+            if self._backend.tier_boundary():
+                # promoted keys arrive with identity window-role planes:
+                # the next incremental fire rebuilds them from the panes
+                self._inc_dirty = True
+
+    def _drain_spill_stage(self) -> None:
         if self._stage is None:
             return
         # lint: sync-ok spill-stage drain gate, once per fire boundary
@@ -1451,6 +1490,15 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
                     kind="stable")[:self._topk]
                 keys = keys[order]
                 results = {n: v[order] for n, v in results.items()}
+        if self._topk is None and len(keys) > 1:
+            # canonical emission order: raw slot order leaks table-insert
+            # history, so a restored (or degraded, or tiered) run would
+            # emit the same rows in a different order than the run it
+            # replaces; host-side sort on the drain stage, off the device
+            # path (top-k already emits in rank order)
+            order = np.argsort(keys, kind="stable")
+            keys = keys[order]
+            results = {n: v[order] for n, v in results.items()}
         DEVICE_STATS.note_d2h(d2h_bytes, len(keys))
         if len(keys):
             self._emit_rows(p_end, keys, results)
@@ -1499,6 +1547,8 @@ class DeviceWindowAggOperator(AsyncFireQueue, CoalescingIngest,
         self._coalesce_flush()
         self._drain(block=True)
         self._refresh_late(block=True)
+        if self._backend is not None and self._backend.tiering_active:
+            self._backend.prefetch_pipeline.close()
 
     def _refresh_late(self, block: bool = False) -> None:
         """Sync the host cache of the device late-drop counter. Non-

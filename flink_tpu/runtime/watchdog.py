@@ -115,6 +115,8 @@ class Watchdog:
         "checkpoint.write": "CHECKPOINT_TIMEOUT",
         "checkpoint.load": "CHECKPOINT_TIMEOUT",
         "rpc.send": "RPC_TIMEOUT",
+        "tier.evict": "TIER_TIMEOUT",
+        "tier.prefetch": "TIER_TIMEOUT",
         "bench.probe": "PROBE_TIMEOUT",
     }
 

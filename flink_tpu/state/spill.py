@@ -20,10 +20,12 @@ without them.
 
 from __future__ import annotations
 
+import threading
 from typing import Iterable, Optional
 
 import numpy as np
 
+from ..core.keygroups import hash_batch, key_groups_for_hash_batch
 from ..native import HostHashIndex
 
 __all__ = ["HostTier", "HOST_IDENT"]
@@ -88,7 +90,19 @@ class HostTier:
         # True where the key group lives on host
         self.spilled_mask = np.zeros(max_parallelism, bool)
         self.evicted_keys = 0      # cumulative keys moved HBM -> host
+        self.promoted_keys = 0     # cumulative keys moved host -> HBM
         self.host_folds = 0        # batches (partially) folded on host
+        # Monotone mutation counter: the prefetch pipeline stages gathers
+        # on a background thread and validates against this at apply time,
+        # so a payload raced by a concurrent fold/absorb is discarded (or
+        # re-gathered synchronously) instead of applied stale.
+        self.version = 0
+        # Guards mutation vs the prefetch thread's multi-read gather: the
+        # version check makes a raced payload harmless, but peek_groups
+        # reads the index and the shadow list at different times and a
+        # fold landing in between tears the gather (mismatched lengths).
+        # RLock because absorb -> slots_for nests.
+        self._mtx = threading.RLock()
 
     @property
     def active(self) -> bool:
@@ -108,10 +122,12 @@ class HostTier:
 
     def slots_for(self, keys: np.ndarray) -> np.ndarray:
         """Upsert spilled-side keys -> dense host slots."""
-        slots = self.index.upsert(keys)
-        self._ensure(len(self.index) + 1)
-        self.record_new_keys(keys, slots)
-        return slots
+        with self._mtx:
+            self.version += 1
+            slots = self.index.upsert(keys)
+            self._ensure(len(self.index) + 1)
+            self.record_new_keys(keys, slots)
+            return slots
 
     def absorb(self, keys: np.ndarray,
                values: dict[str, np.ndarray]) -> None:
@@ -119,24 +135,27 @@ class HostTier:
         [ring?, n] rows aligned with keys)."""
         if len(keys) == 0:
             return
-        slots = self.slots_for(keys)
-        for name, vals in values.items():
-            a = self.arrays[name]
-            if a.ring:
-                _FOLDS[a.kind](a.array, (slice(None), slots), vals)
-            else:
-                _FOLDS[a.kind](a.array, slots, vals)
-        self.evicted_keys += len(keys)
+        with self._mtx:
+            slots = self.slots_for(keys)
+            for name, vals in values.items():
+                a = self.arrays[name]
+                if a.ring:
+                    _FOLDS[a.kind](a.array, (slice(None), slots), vals)
+                else:
+                    _FOLDS[a.kind](a.array, slots, vals)
+            self.evicted_keys += len(keys)
 
     def fold(self, name: str, slots: np.ndarray, values: np.ndarray,
              ring_idx: Optional[np.ndarray]) -> None:
-        a = self.arrays[name]
-        if a.ring:
-            _FOLDS[a.kind](a.array, (ring_idx, slots),
-                           values.astype(a.dtype, copy=False))
-        else:
-            _FOLDS[a.kind](a.array, slots,
-                           values.astype(a.dtype, copy=False))
+        with self._mtx:
+            self.version += 1
+            a = self.arrays[name]
+            if a.ring:
+                _FOLDS[a.kind](a.array, (ring_idx, slots),
+                               values.astype(a.dtype, copy=False))
+            else:
+                _FOLDS[a.kind](a.array, slots,
+                               values.astype(a.dtype, copy=False))
 
     def keys(self) -> np.ndarray:
         """All spilled keys, in dense-slot order (shadow list: the index
@@ -173,9 +192,84 @@ class HostTier:
         return _MERGES[a.kind](a.array[pane_rows][:, :n])
 
     def reset_ring_row(self, row: int) -> None:
-        for a in self.arrays.values():
-            if a.ring:
-                a.array[row] = _ident(a.kind, a.dtype)
+        with self._mtx:
+            self.version += 1
+            for a in self.arrays.values():
+                if a.ring:
+                    a.array[row] = _ident(a.kind, a.dtype)
+
+    # -- promotion support (warm -> hot paging) -------------------------
+    def key_groups(self) -> np.ndarray:
+        """Key group of every spilled key, in dense-slot order."""
+        return key_groups_for_hash_batch(hash_batch(self.keys()),
+                                         self.max_parallelism)
+
+    def group_counts(self) -> np.ndarray:
+        """Spilled-key histogram over key groups [max_parallelism]."""
+        return np.bincount(self.key_groups(),
+                           minlength=self.max_parallelism)
+
+    def peek_groups(self, groups: np.ndarray
+                    ) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+        """Read-only gather of ``groups``' keys and accumulator rows.
+
+        Does NOT remove anything: promotion inserts on device first and
+        only then calls :meth:`drop_groups`, so a failed insert can never
+        strand keys between tiers.  Safe to call from the prefetch thread;
+        the caller validates ``version`` before applying the result.
+        """
+        sel = np.zeros(self.max_parallelism, bool)
+        sel[np.asarray(groups, np.int64)] = True
+        with self._mtx:
+            pick = sel[self.key_groups()]
+            keys = self.keys()[pick].copy()
+            vals = {}
+            n = len(self.index)
+            for name, a in self.arrays.items():
+                vals[name] = (a.array[:, :n][:, pick].copy() if a.ring
+                              else a.array[:n][pick].copy())
+        return keys, vals
+
+    def drop_groups(self, groups: np.ndarray) -> int:
+        """Remove ``groups`` from the tier, rebuilding the dense index.
+
+        HostHashIndex has no delete, so the surviving keys re-upsert into
+        a fresh index (dense slots in insertion order) and the arrays are
+        compacted to match.  Returns how many keys were dropped.
+        """
+        with self._mtx:
+            return self._drop_groups_locked(groups)
+
+    def _drop_groups_locked(self, groups: np.ndarray) -> int:
+        self.version += 1
+        groups = np.asarray(groups, np.int64)
+        sel = np.zeros(self.max_parallelism, bool)
+        sel[groups] = True
+        pick = sel[self.key_groups()]
+        dropped = int(pick.sum())
+        if dropped:
+            keep_keys = self.keys()[~pick]
+            n = len(self.index)
+            keep_vals = {
+                name: (a.array[:, :n][:, ~pick] if a.ring
+                       else a.array[:n][~pick])
+                for name, a in self.arrays.items()}
+            self.index = HostHashIndex(self.cap)
+            self._shadow_arr = np.empty(0, np.int64)
+            for a in self.arrays.values():
+                shape = ((a.ring, self.cap) if a.ring else (self.cap,))
+                a.array = np.full(shape, _ident(a.kind, a.dtype), a.dtype)
+            if len(keep_keys):
+                slots = self.index.upsert(keep_keys)
+                self.record_new_keys(keep_keys, slots)
+                for name, a in self.arrays.items():
+                    if a.ring:
+                        a.array[:, slots] = keep_vals[name]
+                    else:
+                        a.array[slots] = keep_vals[name]
+            self.promoted_keys += dropped
+        self.spilled_mask[groups] = False
+        return dropped
 
     def snapshot_parts(self) -> tuple[np.ndarray, dict[str, np.ndarray]]:
         """(keys, {name: [ring?, n] values}) for checkpointing."""

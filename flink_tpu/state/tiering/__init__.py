@@ -1,0 +1,32 @@
+"""Tiered state residency: device-hot / host-warm paging for 100M+ keys.
+
+The state residency subsystem (ROADMAP open item 3): key cardinality
+beyond the HBM budget keeps the HOT key groups device-resident and pages
+the rest to the host-warm tier (state/spill.py HostTier), with residency
+decided by a decayed frequency+recency policy instead of the bare LRU the
+spill tier started with.
+
+* :mod:`policy` — the deterministic 2Q-style heat policy (pure numpy,
+  seeded tie-breaks, decay on boundary cadence — never wall clock).
+* :mod:`residency` — the :class:`ResidencyManager` driving eviction and
+  promotion decisions per backend, plus the process-global registry the
+  CLI/REST residency table reads.
+* :mod:`prefetch` — the :class:`PrefetchPipeline` staging warm→hot
+  promotions off the mailbox thread (double-buffered h2d staging,
+  watchdog-bounded under site ``tier.prefetch``); promotions apply only
+  at batch boundaries, so the fire path's scatter-free invariants and
+  exactly-once semantics hold.
+"""
+
+from .policy import TieringPolicy
+from .prefetch import PrefetchPipeline
+from .residency import (
+    RESIDENCY_REGISTRY, ResidencyManager, register_residency,
+    residency_table, unregister_residency,
+)
+
+__all__ = [
+    "TieringPolicy", "PrefetchPipeline", "ResidencyManager",
+    "RESIDENCY_REGISTRY", "register_residency", "unregister_residency",
+    "residency_table",
+]

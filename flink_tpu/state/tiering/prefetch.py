@@ -1,0 +1,205 @@
+"""PrefetchPipeline: stage warm→hot promotions off the mailbox thread.
+
+The backend decides *which* key groups to promote (ResidencyManager);
+this pipeline does the expensive part — gathering the groups' rows out of
+the host-warm tier and uploading them into a staged device buffer —
+on a background thread, double-buffered so one payload can stage while
+another waits to be applied.  The mailbox thread only ever:
+
+* enqueues a request (:meth:`request`), and
+* polls for a finished payload at a batch boundary (:meth:`poll`),
+
+so promotions land exactly at batch boundaries and the fire path's
+scatter-free invariants hold.  Staging is watchdog-bounded and
+fault-injectable under site ``tier.prefetch``; a background failure is
+re-raised on the mailbox thread at the next poll.  ``cancel()`` (called
+on restore/restart) bumps an epoch so in-flight stagings are discarded —
+a stale payload can never apply against post-restore state.
+
+This module sits on the tiering hot path (TPU101/JX504 lint): the staging
+callback supplied by the backend owns all device interaction; nothing
+here touches device values or forces a host sync.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_SCOPE = "tiering.prefetch"
+
+
+class PrefetchPipeline:
+    """Double-buffered background staging of promotion payloads.
+
+    ``stage_fn(groups) -> payload | None`` is supplied by the backend and
+    performs the host-tier gather plus the h2d upload of the staged
+    arrays; a ``None`` return means the groups vanished from the warm
+    tier in the meantime and the request is dropped.
+    """
+
+    def __init__(self, stage_fn: Callable[[np.ndarray], Optional[dict]],
+                 *, asynchronous: bool = True, depth: int = 2):
+        self._stage_fn = stage_fn
+        self._asynchronous = bool(asynchronous)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._requests: collections.deque = collections.deque()
+        self._staged: collections.deque = collections.deque(maxlen=max(1, depth))
+        self._pending_groups: set = set()
+        self._epoch = 0
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.staged_total = 0
+        self.cancelled_total = 0
+
+    # ------------------------------------------------------------------
+    # mailbox-thread API
+    # ------------------------------------------------------------------
+    def request(self, groups: Sequence[int]) -> int:
+        """Queue ``groups`` for staging; returns how many were accepted.
+
+        Groups already queued or staged are skipped, so repeated boundary
+        polls do not pile up duplicate work.  In synchronous mode
+        (``state.tiering.async-prefetch: false``) staging happens inline,
+        which keeps single-threaded test runs fully deterministic.
+        """
+        with self._lock:
+            if self._closed:
+                return 0
+            fresh = [int(g) for g in groups
+                     if int(g) not in self._pending_groups]
+            if not fresh:
+                return 0
+            self._pending_groups.update(fresh)
+            self._requests.append((self._epoch, np.asarray(fresh, np.int64)))
+            epoch = self._epoch
+        if self._asynchronous:
+            self._ensure_thread()
+            with self._wake:
+                self._wake.notify()
+        else:
+            self._drain_one(epoch)
+        return len(fresh)
+
+    def poll(self) -> Optional[dict]:
+        """Return a staged payload if one is ready; else ``None``.
+
+        Re-raises any staging failure here, on the mailbox thread, so
+        injected persistent faults surface at a batch boundary instead of
+        dying silently on the background thread.
+        """
+        with self._lock:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            while self._staged:
+                epoch, groups, payload = self._staged.popleft()
+                if epoch != self._epoch:
+                    continue
+                self._pending_groups.difference_update(int(g) for g in groups)
+                return payload
+            return None
+
+    def forget(self, groups: Sequence[int]) -> None:
+        """Drop ``groups`` from the pending set (payload was discarded)."""
+        with self._lock:
+            self._pending_groups.difference_update(int(g) for g in groups)
+
+    def cancel(self) -> None:
+        """Discard queued and staged work; in-flight stagings expire.
+
+        Called on restore/restart: the epoch bump means a payload staged
+        against pre-restore state can never reach :meth:`poll`.
+        """
+        with self._lock:
+            self._epoch += 1
+            dropped = len(self._requests) + len(self._staged) + len(
+                self._pending_groups)
+            self._requests.clear()
+            self._staged.clear()
+            self._pending_groups.clear()
+            self._error = None
+            if dropped:
+                self.cancelled_total += 1
+
+    def close(self) -> None:
+        self.cancel()
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return not (self._requests or self._staged or self._pending_groups)
+
+    # ------------------------------------------------------------------
+    # staging (background thread in async mode, inline otherwise)
+    # ------------------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._worker, name="tier-prefetch", daemon=True)
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._wake:
+                while not self._requests and not self._closed:
+                    self._wake.wait(timeout=1.0)
+                if self._closed:
+                    return
+            self._drain_one()
+
+    def _drain_one(self, only_epoch: Optional[int] = None) -> None:
+        with self._lock:
+            if not self._requests:
+                return
+            epoch, groups = self._requests.popleft()
+            if epoch != self._epoch or (
+                    only_epoch is not None and epoch != only_epoch):
+                self._pending_groups.difference_update(int(g) for g in groups)
+                return
+        try:
+            payload = self._stage(groups)
+        except BaseException as exc:  # surfaced at the next poll()
+            with self._lock:
+                if epoch == self._epoch:
+                    self._error = exc
+                    self._pending_groups.difference_update(
+                        int(g) for g in groups)
+            return
+        with self._lock:
+            if epoch != self._epoch:
+                return
+            if payload is None:
+                self._pending_groups.difference_update(int(g) for g in groups)
+                return
+            self._staged.append((epoch, groups, payload))
+            self.staged_total += 1
+
+    def _stage(self, groups: np.ndarray) -> Optional[dict]:
+        from ...metrics.tracing import TRACER
+        from ...runtime.faults import fire_with_retries
+        from ...runtime.watchdog import WATCHDOG
+        # Fire the fault site before gathering: a transient fault retries
+        # with no state mutated, a persistent one aborts the staging and
+        # surfaces at the next boundary poll.
+        fire_with_retries("tier.prefetch", _SCOPE)
+        with TRACER.span("tier", "Prefetch") as sp:
+            payload = WATCHDOG.run(
+                "tier.prefetch", lambda: self._stage_fn(groups), scope=_SCOPE)
+            sp.set_attribute("groups", int(len(groups)))
+            sp.set_attribute("keys", 0 if payload is None
+                             else int(payload.get("n", 0)))
+        return payload

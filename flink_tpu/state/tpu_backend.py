@@ -34,7 +34,7 @@ import numpy as np
 
 from ..core.keygroups import KeyGroupRange, hash_batch, \
     key_groups_for_hash_batch
-from ..metrics.device import instrumented_program_cache
+from ..metrics.device import DEVICE_STATS, instrumented_program_cache
 from ..ops.hash_table import (
     EMPTY_KEY, lookup, lookup_or_insert, make_table, sanitize_keys_device,
 )
@@ -42,6 +42,7 @@ from ..ops.segment_ops import AGG_INITS, make_accumulator, scatter_fold
 from .backend import KeyedStateBackend, State, ValueState, register_backend
 from .descriptors import StateDescriptor
 from .spill import HostTier
+from .tiering import PrefetchPipeline, ResidencyManager
 
 __all__ = ["TpuKeyedStateBackend"]
 
@@ -50,6 +51,25 @@ def _sanitize_keys(keys: np.ndarray) -> np.ndarray:
     """Remap the EMPTY sentinel (int64 max) to int64 max - 1."""
     return np.where(keys == np.int64(EMPTY_KEY), np.int64(EMPTY_KEY) - 1,
                     keys.astype(np.int64))
+
+
+def _tiering_params(config) -> dict:
+    """Resolve state.tiering.* knobs (option defaults when the backend is
+    constructed without a Configuration, e.g. directly in tests)."""
+    from ..core.config import TieringOptions as T
+    if config is None:
+        return {"seed": T.SEED.default,
+                "decay_interval": T.DECAY_INTERVAL.default,
+                "decay_factor": T.DECAY_FACTOR.default,
+                "promote_headroom": T.PROMOTE_HEADROOM.default,
+                "promote_min_heat": T.PROMOTE_MIN_HEAT.default,
+                "async_prefetch": T.ASYNC_PREFETCH.default}
+    return {"seed": int(config.get(T.SEED)),
+            "decay_interval": int(config.get(T.DECAY_INTERVAL)),
+            "decay_factor": float(config.get(T.DECAY_FACTOR)),
+            "promote_headroom": float(config.get(T.PROMOTE_HEADROOM)),
+            "promote_min_heat": float(config.get(T.PROMOTE_MIN_HEAT)),
+            "async_prefetch": bool(config.get(T.ASYNC_PREFETCH))}
 
 
 # ----------------------------------------------------------------------
@@ -255,8 +275,26 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 self.table = make_table(cap)
         self._budget = budget
         self._host: Optional[HostTier] = None
-        self._last_touch = np.zeros(max_parallelism, np.int64)
         self._batch_no = 0
+        # tiered residency (state/tiering/): the manager owns the decayed
+        # 2Q heat policy deciding WHICH groups evict/promote; the pipeline
+        # stages warm->hot promotions off the mailbox thread. Both exist
+        # only under a budget; decisions apply at batch boundaries
+        # (tier_boundary) and on overflow pressure (_evict_cold_groups).
+        self._residency: Optional[ResidencyManager] = None
+        self._prefetch: Optional[PrefetchPipeline] = None
+        if budget:
+            params = _tiering_params(config)
+            self._residency = ResidencyManager(
+                max_parallelism, budget,
+                seed=params["seed"],
+                decay_interval=params["decay_interval"],
+                decay_factor=params["decay_factor"],
+                promote_headroom=params["promote_headroom"],
+                promote_min_heat=params["promote_min_heat"])
+            self._prefetch = PrefetchPipeline(
+                self._stage_promotion,
+                asynchronous=params["async_prefetch"])
         self._pending_host: Optional[tuple[np.ndarray, np.ndarray]] = None
         # -- incremental snapshot capture (delta CAPTURE, the analog of
         # RocksIncrementalSnapshotStrategy.java:70's SST diff): a device
@@ -376,7 +414,9 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             self._batch_no += 1
             groups = key_groups_for_hash_batch(hash_batch(keys),
                                                self.max_parallelism)
-            self._last_touch[groups] = self._batch_no
+            self._residency.observe(
+                groups, self._batch_no,
+                self._host.spilled_mask if self._host is not None else None)
         dkeys = jnp.asarray(keys)
         while True:
             # keep the device call's shapes CONSTANT across batches (one
@@ -571,43 +611,73 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def _evict_cold_groups(self, rebuild_capacity: Optional[int] = None,
                            batch_groups: Optional[np.ndarray] = None
                            ) -> None:
-        """Page the coldest resident key groups to the host tier and
-        rebuild the device table without them — the unit of movement is
-        the key group (KeyGroupRangeAssignment.java:63), LRU by the last
-        batch that touched the group. When the resident set alone cannot
-        make room (e.g. one batch introduces more new keys than the whole
-        budget), groups OF THE INCOMING BATCH are marked spilled too —
-        each call spills at least one, so the caller's retry loop always
+        """Page the coldest resident key groups to the host tier —
+        deadline-bounded under site ``tier.evict`` (the d2h pull plus the
+        device-table rebuild used to run unbounded inline on the mailbox
+        thread; a wedged DMA now raises StallError into the restart path
+        instead of freezing ingest). The fault site fires BEFORE any
+        state moves: a transient trip retries with nothing mutated, a
+        persistent one fails the batch."""
+        from ..runtime.faults import fire_with_retries
+        from ..runtime.watchdog import WATCHDOG
+        fire_with_retries("tier.evict", scope="tpu_backend.tier")
+        WATCHDOG.run(
+            "tier.evict",
+            lambda: self._evict_cold_groups_inner(rebuild_capacity,
+                                                  batch_groups),
+            scope="tpu_backend.tier")
+
+    def _evict_cold_groups_inner(self,
+                                 rebuild_capacity: Optional[int] = None,
+                                 batch_groups: Optional[np.ndarray] = None
+                                 ) -> None:
+        """Eviction body: the unit of movement is the key group
+        (KeyGroupRangeAssignment.java:63), coldest first by the residency
+        policy's decayed 2Q order (probationary by recency, then
+        protected by heat). When the resident set alone cannot make room
+        (e.g. one batch introduces more new keys than the whole budget),
+        groups OF THE INCOMING BATCH are marked spilled too — each call
+        spills at least one, so the caller's retry loop always
         terminates."""
+        from ..metrics.tracing import TRACER
         self._ensure_host_tier()
         cap = rebuild_capacity or self.capacity
-        keys_dev, slots_dev, groups_dev = self._device_resident()
-        counts = np.bincount(groups_dev, minlength=self.max_parallelism)
-        resident = np.flatnonzero(counts > 0)
-        order = resident[np.argsort(self._last_touch[resident],
-                                    kind="stable")]
-        target = int(0.4 * cap)
-        need = max(len(keys_dev) - target, max(1, len(keys_dev) // 4))
-        evict_groups, acc = [], 0
-        for g in order:
-            evict_groups.append(int(g))
-            acc += int(counts[g])
-            if acc >= need:
-                break
-        if acc < need and batch_groups is not None:
-            # resident set can't make room: spill half the incoming
-            # batch's (not yet spilled) groups as well
-            fresh = np.unique(batch_groups)
-            fresh = fresh[~self._host.spilled_mask[fresh]]
-            fresh = [int(g) for g in fresh if g not in set(evict_groups)]
-            evict_groups.extend(fresh[:max(1, len(fresh) // 2)])
-        if not evict_groups:
-            raise RuntimeError(
-                "spill eviction made no progress; raise the HBM budget")
-        gmask = np.zeros(self.max_parallelism, bool)
-        gmask[evict_groups] = True
-        self._absorb_and_rebuild(keys_dev, slots_dev, gmask[groups_dev],
-                                 evict_groups, cap)
+        with TRACER.span("tier", "Evict") as sp:
+            keys_dev, slots_dev, groups_dev = self._device_resident()
+            counts = np.bincount(groups_dev,
+                                 minlength=self.max_parallelism)
+            resident = np.flatnonzero(counts > 0)
+            order = self._residency.eviction_order(resident)
+            target = int(0.4 * cap)
+            need = max(len(keys_dev) - target, max(1, len(keys_dev) // 4))
+            evict_groups, acc = [], 0
+            for g in order:
+                evict_groups.append(int(g))
+                acc += int(counts[g])
+                if acc >= need:
+                    break
+            if acc < need and batch_groups is not None:
+                # resident set can't make room: spill half the incoming
+                # batch's (not yet spilled) groups as well
+                fresh = np.unique(batch_groups)
+                fresh = fresh[~self._host.spilled_mask[fresh]]
+                fresh = [int(g) for g in fresh
+                         if g not in set(evict_groups)]
+                evict_groups.extend(fresh[:max(1, len(fresh) // 2)])
+            if not evict_groups:
+                raise RuntimeError(
+                    "spill eviction made no progress; raise the HBM "
+                    "budget")
+            gmask = np.zeros(self.max_parallelism, bool)
+            gmask[evict_groups] = True
+            sel = gmask[groups_dev]
+            self._absorb_and_rebuild(keys_dev, slots_dev, sel,
+                                     evict_groups, cap)
+            self._residency.note_demoted(np.asarray(evict_groups, np.int64))
+            DEVICE_STATS.note_tier_eviction(len(evict_groups),
+                                            int(sel.sum()))
+            sp.set_attribute("groups", len(evict_groups))
+            sp.set_attribute("keys", int(sel.sum()))
 
     # -- deferred spill (device-side split; see device_window) ----------
     @property
@@ -643,10 +713,13 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             self._spilled_dev = jnp.asarray(self._host.spilled_mask)
 
     def _sync_touch_from_device(self) -> None:
-        if self._touch_dev is not None:
-            self._last_touch = np.maximum(
-                self._last_touch,
-                np.asarray(jax.device_get(self._touch_dev)))
+        """Merge the on-device per-group touch clock into the residency
+        policy (deferred spill path: the fused step maintains the clock,
+        the policy only sees it at boundaries / eviction time)."""
+        if self._touch_dev is not None and self._residency is not None:
+            self._residency.adopt_clock(
+                np.asarray(jax.device_get(self._touch_dev)),
+                self._host.spilled_mask if self._host is not None else None)
 
     def _ensure_host_tier(self) -> HostTier:
         if self._host is None:
@@ -689,12 +762,33 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def _force_spill_groups(self, groups: np.ndarray) -> None:
         """Page the given key groups to the host tier NOW (deferred-spill
         drain: a group touched by staging overflow becomes host-resident
-        so no key is ever split across tiers)."""
-        keys_dev, slots_dev, g_dev = self._device_resident()
-        gmask = np.zeros(self.max_parallelism, bool)
-        gmask[np.asarray(groups, np.int64)] = True
-        self._absorb_and_rebuild(keys_dev, slots_dev, gmask[g_dev], groups,
-                                 self.capacity)
+        so no key is ever split across tiers). Same guarded demotion as
+        `_evict_cold_groups`: the `tier.evict` fault site fires BEFORE
+        anything moves, the move runs under the watchdog deadline, and
+        the residency manager accounts the demotion."""
+        groups = np.asarray(groups, np.int64)
+        from ..runtime.faults import fire_with_retries
+        from ..runtime.watchdog import WATCHDOG
+        fire_with_retries("tier.evict", scope="tpu_backend.tier")
+        WATCHDOG.run("tier.evict",
+                     lambda: self._force_spill_groups_inner(groups),
+                     scope="tpu_backend.tier")
+
+    def _force_spill_groups_inner(self, groups: np.ndarray) -> None:
+        from ..metrics.tracing import TRACER
+        with TRACER.span("tier", "Evict") as sp:
+            keys_dev, slots_dev, g_dev = self._device_resident()
+            gmask = np.zeros(self.max_parallelism, bool)
+            gmask[groups] = True
+            sel = gmask[g_dev]
+            self._absorb_and_rebuild(keys_dev, slots_dev, sel, groups,
+                                     self.capacity)
+            if self._residency is not None:
+                self._residency.note_demoted(groups)
+            DEVICE_STATS.note_tier_eviction(len(groups), int(sel.sum()))
+            sp.set_attribute("groups", int(len(groups)))
+            sp.set_attribute("keys", int(sel.sum()))
+            sp.set_attribute("forced", True)
 
     def drain_staged(self, keys: np.ndarray, ring_idx: np.ndarray,
                      values: dict[str, np.ndarray]) -> None:
@@ -717,6 +811,136 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             st = self._array_states[name]
             host.fold(name, hslots, np.asarray(vals),
                       np.asarray(ring_idx) if st.ring else None)
+
+    # ------------------------------------------------------------------
+    # tiered residency (state/tiering/): promotion pipeline + boundary hook
+    # ------------------------------------------------------------------
+    @property
+    def tiering_active(self) -> bool:
+        return self._residency is not None
+
+    @property
+    def residency(self) -> Optional[ResidencyManager]:
+        return self._residency
+
+    @property
+    def prefetch_pipeline(self) -> Optional[PrefetchPipeline]:
+        return self._prefetch
+
+    def _hbm_bytes_in_use(self) -> int:
+        """Device bytes held by the keyed-state planes (table + every
+        array state). Shape metadata only — never a device sync."""
+        total = int(self.table.nbytes)
+        for st in self._array_states.values():
+            total += int(st.array.nbytes)
+        return total
+
+    def tier_boundary(self) -> bool:
+        """Batch-boundary tiering step, called by the operator after the
+        staged-spill drain (so nothing is in flight for any group):
+        advance the decay cadence, queue promotion candidates on the
+        prefetch pipeline, and apply at most one staged payload. Returns
+        True when residency changed (a promotion landed) so the operator
+        can invalidate derived window planes."""
+        if self._residency is None:
+            return False
+        self._sync_touch_from_device()
+        self._residency.on_boundary()
+        changed = False
+        host = self._host
+        if host is not None and host.active and self._prefetch is not None:
+            cands = self._residency.promotion_candidates(
+                host.spilled_mask, host.group_counts(), self._num_keys,
+                self.capacity)
+            if len(cands):
+                self._prefetch.request(cands)
+            payload = self._prefetch.poll()
+            if payload is not None:
+                changed = self.apply_promotion(payload)
+            self._residency.update_view(host.spilled_mask,
+                                        host.group_counts())
+        DEVICE_STATS.set_tier_hbm_bytes(self._hbm_bytes_in_use())
+        return changed
+
+    def _stage_promotion(self, groups: np.ndarray) -> Optional[dict]:
+        """Gather ``groups``' warm rows and upload the staged device
+        arrays (runs on the prefetch thread in async mode). The gather is
+        read-only and versioned: apply_promotion re-validates against
+        the host tier's mutation counter, so a payload raced by a
+        concurrent fold is re-gathered, never applied stale. Keys pad to
+        the next power of two (valid-masked) so the insert and scatters
+        reuse a bounded set of executables — residency changes stay
+        recompile-free."""
+        host = self._host
+        if host is None:
+            return None
+        version = host.version
+        groups = np.asarray(groups, np.int64)
+        groups = groups[host.spilled_mask[groups]]
+        if len(groups) == 0:
+            return None
+        keys, vals = host.peek_groups(groups)
+        n = len(keys)
+        if n == 0:
+            return None
+        from ..ops.segment_ops import pow2_ceil
+        P = pow2_ceil(max(n, 1))
+        pkeys = np.zeros(P, np.int64)
+        pkeys[:n] = keys
+        valid = np.zeros(P, bool)
+        valid[:n] = True
+        dvals = {}
+        for name, v in vals.items():
+            pad = P - n
+            if pad:
+                v = np.concatenate(
+                    [v, np.zeros(v.shape[:-1] + (pad,), v.dtype)], axis=-1)
+            dvals[name] = jnp.asarray(v)
+        return {"groups": groups, "version": version, "n": n,
+                "dkeys": jnp.asarray(pkeys), "valid": jnp.asarray(valid),
+                "values": dvals}
+
+    def apply_promotion(self, payload: dict) -> bool:
+        """Install a staged promotion at a batch boundary (mailbox
+        thread): insert the keys into the device table at FIXED capacity,
+        scatter the staged rows into every snapshot-state plane, then —
+        only after the insert fully succeeded — drop the groups from the
+        host tier and clear their spilled flags. Ordering guarantees a
+        key is never split across (or lost between) tiers."""
+        host = self._host
+        groups = np.asarray(payload["groups"], np.int64)
+        if host is None:
+            return False
+        if payload["version"] != host.version:
+            # raced by a host-tier mutation since staging: re-gather
+            # synchronously (small, boundary-amortized) and fall through
+            payload = self._stage_promotion(groups)
+            if payload is None:
+                return False
+        n = int(payload["n"])
+        if self._num_keys + n > int(0.6 * self.capacity):
+            self._prefetch.forget(groups)
+            return False  # headroom gone since staging; stay warm
+        new_table, slots, ok = lookup_or_insert(
+            self.table, payload["dkeys"], payload["valid"])
+        if not bool(jax.device_get((ok | ~payload["valid"]).all())):
+            self._prefetch.forget(groups)
+            return False  # table could not admit; discard, keys stay warm
+        self.table = new_table
+        self._num_keys += n
+        widx = jnp.where(payload["valid"], slots, self.capacity)
+        for name, st in self._snapshot_states():
+            dv = payload["values"][name]
+            if st.ring:
+                st.array = st.array.at[:, widx].set(dv, mode="drop")
+            else:
+                st.array = st.array.at[widx].set(dv, mode="drop")
+        host.drop_groups(groups)
+        self._sync_spilled_dev()
+        self.mark_dirty(slots)
+        self._residency.note_promoted(groups)
+        DEVICE_STATS.note_tier_prefetch(len(groups), n)
+        return True
 
     def register_array_state(self, name: str, kind: str, dtype,
                              ring: Optional[int] = None,
@@ -1088,6 +1312,12 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             keys = np.concatenate([keys, host_keys])
             groups = np.concatenate([groups, key_groups_for_hash_batch(
                 hash_batch(host_keys), self.max_parallelism)])
+        # canonical (group, key) order: the snapshot is residency-AGNOSTIC
+        # — byte-identical whether a key group is device-hot or host-warm
+        # (raw order would leak slot/eviction history into the artifact)
+        order = np.lexsort((keys, groups))
+        keys = np.ascontiguousarray(keys[order])
+        groups = np.ascontiguousarray(groups[order])
         states = {}
         for name, st in self._snapshot_states():
             arr = self._mirror["arrays"][name]
@@ -1095,6 +1325,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             if host_vals is not None:
                 vals = np.concatenate(
                     [vals, host_vals[name].astype(vals.dtype)], axis=-1)
+            vals = np.ascontiguousarray(vals[..., order])
             states[name] = {"kind": st.kind, "dtype": str(np.dtype(st.dtype)),
                             "ring": st.ring, "values": vals}
         return {"kind": "tpu", "keys": keys, "key_groups": groups,
@@ -1109,6 +1340,10 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         mid-rebuild)."""
         from ..runtime.watchdog import WATCHDOG
 
+        if self._prefetch is not None:
+            # restart/restore boundary: in-flight promotion stagings were
+            # gathered against pre-restore state — cancel, never apply
+            self._prefetch.cancel()
         snapshots = list(snapshots)
         WATCHDOG.run("transfer.h2d",
                      lambda: self._restore_inner(snapshots),
