@@ -255,6 +255,44 @@ def _collect_metrics(env, before: dict) -> dict:
     return out
 
 
+def _ledger_before() -> dict:
+    from flink_tpu.metrics.profiler import DEVICE_LEDGER
+    return DEVICE_LEDGER.snapshot()
+
+
+def _device_time_block(before: dict) -> dict:
+    """This run's device-time attribution from the process-global
+    ledger: per-site and per-operator device-ms deltas with shares of
+    the stage total (shares partition the same sum, so they add up to
+    1.0 up to rounding — the report's consistency check)."""
+    from flink_tpu.metrics.profiler import DEVICE_LEDGER
+
+    after = DEVICE_LEDGER.snapshot()
+    total = after["device_ms_total"] - before.get("device_ms_total", 0.0)
+    compile_ms = (after["compile_ms_total"]
+                  - before.get("compile_ms_total", 0.0))
+
+    def deltas(field: str) -> dict:
+        out = {}
+        for name, row in after.get(field, {}).items():
+            prev = before.get(field, {}).get(name, {})
+            ms = row["device_ms"] - prev.get("device_ms", 0.0)
+            n = row["count"] - prev.get("count", 0)
+            if ms > 0.0 or n > 0:
+                out[name] = {"ms": round(ms, 3), "count": n,
+                             "share": (round(ms / total, 4)
+                                       if total > 0.0 else 0.0)}
+        return out
+
+    return {"enabled": after["enabled"],
+            "total_ms": round(total, 3),
+            "compile_ms": round(compile_ms, 3),
+            "dispatches": (after["dispatches_total"]
+                           - before.get("dispatches_total", 0)),
+            "by_site": deltas("sites"),
+            "by_operator": deltas("operators")}
+
+
 def _run_q5(n_keys: int, n_events: int, capacity: int,
             pane_ms: int = 2000, topk: int = 1000, device: bool = True,
             batch: int = BATCH, metrics_registry=None,
@@ -296,10 +334,15 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     from flink_tpu.metrics import DEVICE_STATS
 
     stats_before = DEVICE_STATS.snapshot()
+    led_before = _ledger_before()
     env = StreamExecutionEnvironment.get_execution_environment()
     env.set_state_backend("tpu")
     env.config.set(PipelineOptions.BATCH_SIZE, batch)
     env.config.set("window.fire.incremental", fire_mode == "incremental")
+    # device-time ledger on by default so every stage report carries its
+    # device_time block; extra_config may still override it off (the
+    # overhead A/B measures exactly that)
+    env.config.set("profiler.enabled", True)
     for k, v in (extra_config or {}).items():
         env.config.set(k, v)
     ws = WatermarkStrategy.for_monotonous_timestamps() \
@@ -329,6 +372,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
     lat = [ms for o in ops for ms in o.fire_latencies_ms]
     stages = _collect_stages(env)
     stages.update(_collect_metrics(env, stats_before))
+    stages["device_time"] = _device_time_block(led_before)
     stages["fire_mode"] = fire_mode
     stages["window_panes"] = window_panes
     stages["max_inflight"] = max((o._max_inflight for o in ops), default=0)
@@ -919,6 +963,7 @@ def main(breakdown: bool = False):
     _line("nexmark_q5_framework_events_per_sec_1M_keys", eps,
           "events/sec/chip", eps / host_eps)
     _maybe_write_trace("q5")
+    _maybe_write_profile("q5")
     return eps, p99, stages, host_eps
 
 
@@ -1045,13 +1090,16 @@ def _trace_extra_config() -> dict:
 
 def write_trace(stage: str, prefix: str = None) -> str:
     """Export the global tracer's retained spans for one bench stage as
-    Perfetto-loadable trace-event JSON; returns the path written."""
+    Perfetto-loadable trace-event JSON (plus the device-time ledger's
+    dispatch samples as per-site counter tracks); returns the path."""
+    from flink_tpu.metrics.profiler import DEVICE_LEDGER
     from flink_tpu.metrics.tracing import TRACER, chrome_trace_events
 
     spans = TRACER.retained_spans()
     path = f"{prefix or TRACE_PREFIX or 'bench'}.{stage}.trace.json"
     with open(path, "w") as f:
-        json.dump(chrome_trace_events(spans), f)
+        json.dump(chrome_trace_events(
+            spans, counters=DEVICE_LEDGER.trace_counters()), f)
     print(json.dumps({"metric": "trace_file", "unit": "path",
                       "stage": stage, "path": path, "spans": len(spans)}))
     return path
@@ -1060,6 +1108,45 @@ def write_trace(stage: str, prefix: str = None) -> str:
 def _maybe_write_trace(stage: str) -> None:
     if TRACE_PREFIX:
         write_trace(stage)
+
+
+#: Set by ``--profile [PREFIX]``: each stage prints its top-10
+#: hot-program table and writes the full ledger profile to
+#: ``<PREFIX>.<stage>.profile.json`` (next to the --trace output).
+PROFILE_PREFIX = ""
+
+
+def write_profile(stage: str, prefix: str = None, top: int = 10) -> str:
+    """Dump the device-time ledger's full attribution report for one
+    bench stage as JSON and print the top-``top`` hot-program table;
+    returns the path written."""
+    from flink_tpu.metrics.profiler import DEVICE_LEDGER
+
+    prof = DEVICE_LEDGER.profile(top=top)
+    path = f"{prefix or PROFILE_PREFIX or 'bench'}.{stage}.profile.json"
+    with open(path, "w") as f:
+        json.dump(prof, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({"metric": "profile_file", "unit": "path",
+                      "stage": stage, "path": path,
+                      "programs": len(prof["programs"]),
+                      "total_device_ms": round(prof["total_device_ms"],
+                                               3)}))
+    header = (f"{'site':<28} {'operator':<22} {'n':>7} {'self_ms':>10} "
+              f"{'p95_ms':>8} {'share':>6}")
+    print(header)
+    print("-" * len(header))
+    for p in prof["programs"]:
+        print(f"{p['site']:<28} {(p['operator'] or '-'):<22} "
+              f"{p['count']:>7} {p['self_ms']:>10.2f} "
+              f"{p['p95_ms']:>8.3f} {p['share'] * 100:>5.1f}%")
+    sys.stdout.flush()
+    return path
+
+
+def _maybe_write_profile(stage: str) -> None:
+    if PROFILE_PREFIX:
+        write_profile(stage)
 
 
 def _audit_report() -> dict:
@@ -1115,6 +1202,7 @@ def tiny(fire_mode: str = "full", window_panes_list=(5,),
             rec.update(_audit_report())
         print(json.dumps(rec))
     _maybe_write_trace("tiny_q5")
+    _maybe_write_profile("tiny_q5")
     sys.stdout.flush()
 
 
@@ -1151,9 +1239,11 @@ def _run_fused_stage(fusion_on: bool, batch: int, n_events: int):
     schema = Schema([("auction", np.int64), ("price", np.int64),
                      ("ts", np.int64)])
     stats_before = DEVICE_STATS.snapshot()
+    led_before = _ledger_before()
     env = StreamExecutionEnvironment.get_execution_environment()
     env.set_state_backend("tpu")
     env.config.set(PipelineOptions.BATCH_SIZE, batch)
+    env.config.set("profiler.enabled", True)
     env.config.set(PipelineOptions.FUSION, fusion_on)
     ws = WatermarkStrategy.for_monotonous_timestamps() \
         .with_timestamp_column("ts")
@@ -1171,6 +1261,7 @@ def _run_fused_stage(fusion_on: bool, batch: int, n_events: int):
     env.execute("nexmark-q5-fused", timeout=1800.0)
     wall = time.perf_counter() - t0
     stages = _collect_metrics(env, stats_before)
+    stages["device_time"] = _device_time_block(led_before)
     return wall, sink.rows, stages
 
 
@@ -1201,6 +1292,7 @@ def fused(batch: int = 64, n_batches: int = 512) -> None:
     if "--audit" in sys.argv:
         rec.update(_audit_report())
     print(json.dumps(rec))
+    _maybe_write_profile("fused_q5")
     sys.stdout.flush()
 
 
@@ -1351,6 +1443,7 @@ def chaos(seed: int) -> None:
                 for k, v in stages.items()})
     print(json.dumps(rec))
     _maybe_write_trace("tiny_q5_chaos")
+    _maybe_write_profile("tiny_q5_chaos")
     sys.stdout.flush()
 
 
@@ -1427,6 +1520,12 @@ if __name__ == "__main__":
                         if (len(sys.argv) > i + 1
                             and not sys.argv[i + 1].startswith("--"))
                         else "bench")
+    if "--profile" in sys.argv:
+        i = sys.argv.index("--profile")
+        PROFILE_PREFIX = (sys.argv[i + 1]
+                          if (len(sys.argv) > i + 1
+                              and not sys.argv[i + 1].startswith("--"))
+                          else "bench")
     if "--probe-timeout" in sys.argv:
         # override bench.probe-timeout for this invocation (the config
         # key applies when a job Configuration reaches the watchdog; the

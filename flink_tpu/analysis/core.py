@@ -135,12 +135,14 @@ class AnalysisSettings:
         ("TRACER", ("TRACER",)),
         ("FLIGHT_RECORDER", ("FLIGHT_RECORDER", "TRACER")),
         ("MESH_RUNTIME", ("MESH_RUNTIME",)),
+        ("DEVICE_LEDGER", ("DEVICE_LEDGER",)),
     )
     # Determinism rule: span/tracing modules where time.time() is banned
     # (monotonic-anchored clock only — see now_ms() in metrics/tracing).
     span_clock_modules: Tuple[str, ...] = (
         "metrics/tracing.py",
         "metrics/device.py",
+        "metrics/profiler.py",
     )
     # Determinism rule: runtime module prefixes where unseeded RNG is
     # banned (replayability of fault schedules / recovery paths).

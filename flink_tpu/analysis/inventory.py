@@ -228,6 +228,116 @@ def config_docs_rule(ctx: AnalysisContext) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# TPU305 — ledger-site inventory: code literals == LEDGER_SITE_INVENTORY
+# == the "### Ledger sites" table of docs/OBSERVABILITY.md
+
+# Sites appear either as an instrumented-cache builder scope or as a
+# direct ledger record; both calls wrap arguments, so these run against
+# the whole source (\s* crosses the line break after the open paren).
+_LEDGER_SITE_RE = re.compile(
+    r'(?:instrumented_program_cache|DEVICE_LEDGER\.record)\(\s*'
+    r'"([a-z0-9_.]+)"')
+_LEDGER_DOC_ROW = re.compile(r"^\| `([a-z0-9_.]+)` \|")
+
+
+def _load_ledger_inventory(ctx: AnalysisContext):
+    from flink_tpu.metrics.profiler import LEDGER_SITE_INVENTORY
+    return LEDGER_SITE_INVENTORY
+
+
+@rule("TPU305", "ledger-site inventory drift", "A",
+      "every instrumented_program_cache scope / DEVICE_LEDGER.record "
+      "site literal must appear in LEDGER_SITE_INVENTORY "
+      "(metrics/profiler.py) and in the ledger-site table of "
+      "docs/OBSERVABILITY.md, and vice versa — the inventory is the "
+      "contract profile consumers attribute device time by")
+def ledger_site_rule(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    inv_rel = ctx.pkg_rel("metrics/profiler.py")
+    inventory = _load_ledger_inventory(ctx)
+    inv_sites = {site for site, _where in inventory}
+
+    code_sites: Dict[str, Tuple[str, int]] = {}
+    for rel in ctx.package_files():
+        src = ctx.source(rel)
+        for m in _LEDGER_SITE_RE.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            code_sites.setdefault(m.group(1), (rel, line))
+
+    doc_rel = "docs/OBSERVABILITY.md"
+    doc_sites: Set[str] = set()
+    doc_path = ctx.root / doc_rel
+    if doc_path.is_file():
+        section = doc_path.read_text().split("### Ledger sites", 1)
+        if len(section) == 2:
+            for line in section[1].split("\n#", 1)[0].splitlines():
+                m = _LEDGER_DOC_ROW.match(line)
+                if m:
+                    doc_sites.add(m.group(1))
+    else:
+        findings.append(Finding(
+            rule="TPU305", file=doc_rel, line=0, symbol=doc_rel,
+            message="docs/OBSERVABILITY.md missing", hint="restore it"))
+
+    for site, (rel, line) in sorted(code_sites.items()):
+        if site not in inv_sites:
+            findings.append(Finding(
+                rule="TPU305", file=rel, line=line,
+                symbol=f"code-not-inventoried:{site}",
+                message=f"ledger site '{site}' recorded here but missing "
+                        "from LEDGER_SITE_INVENTORY",
+                hint="add it to LEDGER_SITE_INVENTORY in "
+                     "metrics/profiler.py and to the docs/OBSERVABILITY.md "
+                     "ledger-site table"))
+    for site, where in inventory:
+        if site not in code_sites:
+            findings.append(Finding(
+                rule="TPU305", file=inv_rel, line=0,
+                symbol=f"inventoried-not-in-code:{site}",
+                message=f"LEDGER_SITE_INVENTORY lists '{site}' but no "
+                        "code records it",
+                hint="delete the stale inventory row (and its docs row)"))
+        for cited in re.findall(r"[\w/]+\.py", where):
+            if not (ctx.root / ctx.package_name / cited).is_file():
+                findings.append(Finding(
+                    rule="TPU305", file=inv_rel, line=0,
+                    symbol=f"stale-citation:{site}:{cited}",
+                    message=f"LEDGER_SITE_INVENTORY cites {cited} but "
+                            f"{ctx.package_name}/{cited} does not exist",
+                    hint="fix the 'where' citation"))
+    if doc_path.is_file():
+        if not doc_sites:
+            findings.append(Finding(
+                rule="TPU305", file=doc_rel, line=0,
+                symbol="section-missing",
+                message="docs/OBSERVABILITY.md has no '### Ledger sites' "
+                        "table",
+                hint="add the section (see LEDGER_SITE_INVENTORY)"))
+        else:
+            for site in sorted(inv_sites - doc_sites):
+                findings.append(Finding(
+                    rule="TPU305", file=doc_rel, line=0,
+                    symbol=f"doc-missing:{site}",
+                    message=f"ledger site '{site}' is inventoried but "
+                            "missing from the docs/OBSERVABILITY.md "
+                            "ledger-site table",
+                    hint="add the table row"))
+            for site in sorted(doc_sites - inv_sites):
+                findings.append(Finding(
+                    rule="TPU305", file=doc_rel, line=0,
+                    symbol=f"doc-stale:{site}",
+                    message=f"docs/OBSERVABILITY.md lists ledger site "
+                            f"'{site}' that is not inventoried",
+                    hint="delete the stale table row"))
+    if list(inventory) != sorted(inventory):
+        findings.append(Finding(
+            rule="TPU305", file=inv_rel, line=0, symbol="unsorted",
+            message="LEDGER_SITE_INVENTORY is not sorted by site",
+            hint="keep it sorted so diffs stay reviewable"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # TPU304 — config-key literals must resolve to declared options
 
 _KEYISH_RE = re.compile(r"^[a-z][a-z0-9-]*(\.[a-z0-9-]+)+$")

@@ -490,82 +490,93 @@ def exercise_programs(n_events: int = 4096, batch: int = 1024,
     """Run a tiny Q5 sliding-window job (per fire mode) so every
     window-path builder registers its compiled programs in
     PROGRAM_AUDIT; returns the registered scopes.  Mirrors bench.py
-    _run_q5 at toy scale — same operators, same program builders."""
+    _run_q5 at toy scale — same operators, same program builders.
+
+    The device-time ledger records through the same runs (restored to
+    its prior enablement on return), so the audit doubles as a drill of
+    every ledger-wrapped dispatch site: the TPU305 inventory can be
+    checked against scopes that actually fired, not just grep hits."""
     import numpy as np
 
     from flink_tpu.api import StreamExecutionEnvironment
     from flink_tpu.core import WatermarkStrategy
     from flink_tpu.core.config import PipelineOptions
-    from flink_tpu.core.records import Schema
     from flink_tpu.metrics.device import PROGRAM_AUDIT
+    from flink_tpu.metrics.profiler import DEVICE_LEDGER
+    from flink_tpu.core.records import Schema
     from flink_tpu.runtime.operators.device_window import AggSpec
     from flink_tpu.window import SlidingEventTimeWindows
 
-    schema = Schema([("auction", np.int64), ("price", np.int64),
-                     ("ts", np.int64)])
-    pane_ms = 2000
-    n_panes = max(2, n_events // batch)
-    span = n_panes * pane_ms
+    ledger_was_enabled = DEVICE_LEDGER.enabled
+    DEVICE_LEDGER.enabled = True
+    try:
+        schema = Schema([("auction", np.int64), ("price", np.int64),
+                         ("ts", np.int64)])
+        pane_ms = 2000
+        n_panes = max(2, n_events // batch)
+        span = n_panes * pane_ms
 
-    def gen(idx):
-        u = idx.astype(np.uint64)
-        return {"auction": ((u * np.uint64(2654435761)) % np.uint64(64))
-                .astype(np.int64),
-                "price": (idx % 97) + 1,
-                "ts": (idx * span) // n_events}
+        def gen(idx):
+            u = idx.astype(np.uint64)
+            return {"auction": ((u * np.uint64(2654435761)) % np.uint64(64))
+                    .astype(np.int64),
+                    "price": (idx % 97) + 1,
+                    "ts": (idx * span) // n_events}
 
-    from flink_tpu.core.functions import SinkFunction
+        from flink_tpu.core.functions import SinkFunction
 
-    class _DiscardSink(SinkFunction):
-        def invoke_batch(self, batch):
-            return True
+        class _DiscardSink(SinkFunction):
+            def invoke_batch(self, batch):
+                return True
 
-    # (fire_mode, device_ingest, fused): device ingest exercises the
-    # coalesced native_fold program, host ingest the per-batch step
-    # program, and the fused run registers the certified chain programs
-    # (chain.fused_prelude / chain.fused_step) for JX601-603.
-    runs = ([(m, True, False) for m in fire_modes]
-            + [(fire_modes[0], False, False), (fire_modes[0], True, True)])
-    for fire_mode, device_ingest, fused in runs:
-        env = StreamExecutionEnvironment.get_execution_environment()
-        env.set_state_backend("tpu")
-        env.config.set(PipelineOptions.BATCH_SIZE, batch)
-        env.config.set(PipelineOptions.FUSION, fused)
-        env.config.set("window.fire.incremental",
-                       fire_mode == "incremental")
-        ws = WatermarkStrategy.for_monotonous_timestamps() \
-            .with_timestamp_column("ts")
-        (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
-                     watermark_strategy=ws, device=device_ingest)
-            .key_by("auction")
-            .window(SlidingEventTimeWindows.of(3 * pane_ms, pane_ms))
-            .device_aggregate(
-                [AggSpec("count", out_name="bids", value_bits=31),
-                 AggSpec("sum", "price", out_name="revenue")],
-                capacity=capacity, ring_size=16, emit_window_bounds=False,
-                emit_topk=32, defer_overflow=True)
-            .add_sink(_DiscardSink(), "audit-sink"))
-        env.execute(f"tpu-lint-audit-{fire_mode}", timeout=600.0)
+        # (fire_mode, device_ingest, fused): device ingest exercises the
+        # coalesced native_fold program, host ingest the per-batch step
+        # program, and the fused run registers the certified chain programs
+        # (chain.fused_prelude / chain.fused_step) for JX601-603.
+        runs = ([(m, True, False) for m in fire_modes]
+                + [(fire_modes[0], False, False), (fire_modes[0], True, True)])
+        for fire_mode, device_ingest, fused in runs:
+            env = StreamExecutionEnvironment.get_execution_environment()
+            env.set_state_backend("tpu")
+            env.config.set(PipelineOptions.BATCH_SIZE, batch)
+            env.config.set(PipelineOptions.FUSION, fused)
+            env.config.set("window.fire.incremental",
+                           fire_mode == "incremental")
+            ws = WatermarkStrategy.for_monotonous_timestamps() \
+                .with_timestamp_column("ts")
+            (env.datagen(gen, schema, count=n_events, timestamp_column="ts",
+                         watermark_strategy=ws, device=device_ingest)
+                .key_by("auction")
+                .window(SlidingEventTimeWindows.of(3 * pane_ms, pane_ms))
+                .device_aggregate(
+                    [AggSpec("count", out_name="bids", value_bits=31),
+                     AggSpec("sum", "price", out_name="revenue")],
+                    capacity=capacity, ring_size=16, emit_window_bounds=False,
+                    emit_topk=32, defer_overflow=True)
+                .add_sink(_DiscardSink(), "audit-sink"))
+            env.execute(f"tpu-lint-audit-{fire_mode}", timeout=600.0)
 
-    # sharded (mesh.*) programs: one direct step + fused fire on a tiny
-    # ShardedWindowAgg so the JX505 local-key audit has entries to lint
-    import jax
-    import jax.numpy as jnp
+        # sharded (mesh.*) programs: one direct step + fused fire on a tiny
+        # ShardedWindowAgg so the JX505 local-key audit has entries to lint
+        import jax
+        import jax.numpy as jnp
 
-    from flink_tpu.parallel.mesh import make_mesh
-    from flink_tpu.parallel.sharded_window import AggDef, ShardedWindowAgg
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_window import AggDef, ShardedWindowAgg
 
-    D = max(1, min(4, len(jax.devices())))
-    agg = ShardedWindowAgg(make_mesh(D),
-                           [AggDef("price", "sum", jnp.int64)],
-                           capacity=256, ring=8, max_parallelism=128)
-    state = agg.init_state()
-    B = 64
-    keys = (jnp.arange(D * B, dtype=jnp.int64) % 37).reshape(D, B) + 1
-    state, _ = agg.step(state, keys,
-                        {"price": jnp.ones((D, B), jnp.int64)},
-                        jnp.zeros((D, B), jnp.int32),
-                        jnp.ones((D, B), bool))
-    agg.fire_compact(state, np.arange(4), np.ones(4, bool),
-                     "price", 8)
-    return sorted({e.scope for e in PROGRAM_AUDIT})
+        D = max(1, min(4, len(jax.devices())))
+        agg = ShardedWindowAgg(make_mesh(D),
+                               [AggDef("price", "sum", jnp.int64)],
+                               capacity=256, ring=8, max_parallelism=128)
+        state = agg.init_state()
+        B = 64
+        keys = (jnp.arange(D * B, dtype=jnp.int64) % 37).reshape(D, B) + 1
+        state, _ = agg.step(state, keys,
+                            {"price": jnp.ones((D, B), jnp.int64)},
+                            jnp.zeros((D, B), jnp.int32),
+                            jnp.ones((D, B), bool))
+        agg.fire_compact(state, np.arange(4), np.ones(4, bool),
+                         "price", 8)
+        return sorted({e.scope for e in PROGRAM_AUDIT})
+    finally:
+        DEVICE_LEDGER.enabled = ledger_was_enabled

@@ -304,9 +304,11 @@ def _cmd_state_residency(args) -> int:
                   file=sys.stderr)
             return 1
         rows = payload.get("rows", [])
+        series = payload.get("hit_ratio_series", {})
     else:
-        from .state.tiering import residency_table
+        from .state.tiering import hit_ratio_series, residency_table
         rows = residency_table(args.job)
+        series = hit_ratio_series(args.job)
     if not rows:
         print("no tiered state registered (is the job running under "
               "state.backend.tpu.hbm-budget-bytes / -slots?)")
@@ -317,6 +319,73 @@ def _cmd_state_residency(args) -> int:
     _print_table(["operator", "key_group", "tier", "stage", "warm_keys",
                   "heat", "last_touch"], cells, max_rows=args.max_rows)
     print(f"{warm} warm / {len(rows) - warm} hot key group(s)")
+    # per-boundary hot-hit-ratio trajectory (last boundaries, oldest
+    # first): the cumulative tier_hot_hit_ratio gauge hides phase
+    # changes — a paging storm shows up here as a dip
+    for op, vals in sorted(series.items()):
+        if vals:
+            print(f"hit_ratio[{op}] last {len(vals)} boundar(y/ies): "
+                  + " ".join(f"{v:.2f}" for v in vals))
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    """Print a job's device-time ledger profile: top-K hot programs
+    (device-time share, percentiles, cost-model achieved-vs-estimated),
+    per-operator device-time shares, and recompile-attribution records
+    naming the argument that changed. Fetches ``/jobs/<name>/profile``
+    from a running endpoint, or falls back to THIS process's ledger when
+    no ``--target`` is given (useful right after an in-process run with
+    profiler.enabled)."""
+    import json as _json
+    import urllib.request
+
+    if args.target:
+        url = (f"http://{args.target}/jobs/{args.job}/profile"
+               f"?top={args.top}")
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                payload = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"profile: cannot fetch {url}: {e}", file=sys.stderr)
+            return 1
+    else:
+        from .metrics.profiler import DEVICE_LEDGER
+        payload = DEVICE_LEDGER.profile(job=args.job or None, top=args.top)
+    if args.json:
+        print(_json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    if not payload.get("enabled"):
+        print("device-time ledger is disabled (run with "
+              "profiler.enabled: true)")
+    progs = payload.get("programs", [])
+    if not progs:
+        print("no attributed device time recorded")
+        return 0
+    print(f"job {payload.get('job') or '<all>'}: "
+          f"{payload.get('total_device_ms', 0.0):.2f} ms device, "
+          f"{payload.get('total_compile_ms', 0.0):.2f} ms compile")
+
+    def _fmt(v, spec=".3f"):
+        return format(v, spec) if isinstance(v, (int, float)) else "-"
+
+    rows = [[p["site"], p["operator"] or "-", p["count"],
+             _fmt(p["self_ms"], ".2f"), _fmt(p["p50_ms"]),
+             _fmt(p["p95_ms"]), _fmt(p["max_ms"]),
+             f"{p['share'] * 100:.1f}%", _fmt(p.get("est_ms")),
+             _fmt(p.get("achieved_vs_estimated"), ".2f")] for p in progs]
+    _print_table(["site", "operator", "n", "self_ms", "p50", "p95",
+                  "max", "share", "est_ms", "ach/est"], rows,
+                 max_rows=args.top)
+    ops = payload.get("operators", [])
+    if ops:
+        _print_table(["operator", "device_ms", "share"],
+                     [[o["operator"] or "-", _fmt(o["device_ms"], ".2f"),
+                       f"{o['share'] * 100:.1f}%"] for o in ops],
+                     max_rows=args.top)
+    for r in payload.get("recompiles", []):
+        changed = "; ".join(r.get("changed") or ()) or "<no arg diff>"
+        print(f"recompile {r['site']}: {changed}")
     return 0
 
 
@@ -612,6 +681,22 @@ def main(argv: Optional[list[str]] = None) -> int:
                           "current process's residency registry")
     srr.add_argument("--max-rows", type=int, default=200)
     srr.set_defaults(fn=_cmd_state_residency)
+
+    prf = sub.add_parser(
+        "profile",
+        help="print a job's device-time ledger profile (hot programs, "
+             "per-operator shares, recompile attribution)")
+    prf.add_argument("job", nargs="?", default="",
+                     help="job name; empty = every attributed job "
+                          "(local fallback only)")
+    prf.add_argument("--target", default="",
+                     help="host:port of a REST endpoint; empty = the "
+                          "current process's ledger")
+    prf.add_argument("--top", type=int, default=10,
+                     help="programs to show (default 10)")
+    prf.add_argument("--json", action="store_true",
+                     help="machine-readable payload")
+    prf.set_defaults(fn=_cmd_profile)
 
     gwp = sub.add_parser("sql-gateway",
                          help="serve the REST SQL gateway")

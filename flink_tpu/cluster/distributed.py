@@ -728,6 +728,9 @@ class DistributedHost:
         set_compile_tracer(TRACER if TRACER.enabled else None)
         from ..parallel.plan import MESH_RUNTIME
         MESH_RUNTIME.configure(config)
+        # device-time ledger (same wiring as deploy_local)
+        from ..metrics.profiler import DEVICE_LEDGER
+        DEVICE_LEDGER.configure(config)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "

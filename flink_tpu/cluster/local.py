@@ -189,11 +189,17 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     # by ANY task must agree on the partition rules
     from ..parallel.plan import MESH_RUNTIME
     MESH_RUNTIME.configure(config)
+    # device-time ledger: per-program dispatch profiling + recompile
+    # attribution (off by default — profiler.enabled)
+    from ..metrics.profiler import DEVICE_LEDGER
+    DEVICE_LEDGER.configure(config)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape
         from ..metrics.device import bind_device_metrics
+        from ..metrics.profiler import bind_ledger_metrics
         bind_device_metrics(metrics_registry)
+        bind_ledger_metrics(metrics_registry)
 
     # channels[edge_key][src_sub][dst_sub]; feedback channels are UNBOUNDED:
     # a bounded back edge would wedge the body forever once the head exits

@@ -18,6 +18,10 @@ reduced to the operationally useful slice:
                                      tracing; metrics/tracing.py)
     GET  /jobs/<name>/flight-recorder -> flight-recorder dump records +
                                      the live ring's tail (post-mortems)
+    GET  /jobs/<name>/profile     -> device-time ledger profile: top-k
+                                     hot programs, per-operator device-
+                                     time shares, recompile attribution
+                                     (``?top=K`` bounds the program list)
     POST /jobs/<name>/savepoints  -> trigger a savepoint, returns its path
     GET  /metrics                 -> prometheus text exposition (always
                                      includes the device-path scope:
@@ -155,8 +159,22 @@ class RestEndpoint:
         window operators register into at setup."""
         if name not in self._jobs:
             return None
-        from ..state.tiering import residency_table
-        return {"name": name, "rows": residency_table(name)}
+        from ..state.tiering import hit_ratio_series, residency_table
+        return {"name": name, "rows": residency_table(name),
+                # per-boundary hot-hit-ratio trajectory (bounded ring):
+                # the cumulative ratio hides phase changes, the series
+                # shows them
+                "hit_ratio_series": hit_ratio_series(name)}
+
+    def _profile(self, name: str, top: int = 10) -> Optional[dict]:
+        """Device-time ledger view of one job: top-``top`` hot programs
+        (with cost-model achieved-vs-estimated), per-operator device-time
+        shares, and the recompile-attribution records. Served from the
+        process-global ledger; empty-but-valid when profiling is off."""
+        if name not in self._jobs:
+            return None
+        from ..metrics.profiler import DEVICE_LEDGER
+        return DEVICE_LEDGER.profile(job=name, top=top)
 
     def _flight_recorder(self, name: str) -> Optional[dict]:
         """Post-mortem surface: the dump records written so far (stalls,
@@ -176,11 +194,13 @@ class RestEndpoint:
         transfer accounting even for endpoints started without a job
         registry."""
         from ..metrics.device import bind_device_metrics
+        from ..metrics.profiler import bind_ledger_metrics
 
         if self.metrics_registry is None:
             from ..metrics.core import MetricRegistry
             self.metrics_registry = MetricRegistry()
         bind_device_metrics(self.metrics_registry)
+        bind_ledger_metrics(self.metrics_registry)
         return self.metrics_registry
 
     def _metrics_snapshot(self) -> dict:
@@ -195,6 +215,17 @@ class RestEndpoint:
         # subtask's last progress-epoch bump
         snap.update({f"task.{tid}.last_progress_age_ms": age
                      for tid, age in PROGRESS.ages_ms().items()})
+        # device-time ledger rollups (per-job device/compile ms) when
+        # profiling is on — the dashboard's device panel polls this
+        from ..metrics.profiler import DEVICE_LEDGER
+        if DEVICE_LEDGER.enabled:
+            led = DEVICE_LEDGER.snapshot()
+            snap["profiler.device_ms_total"] = led["device_ms_total"]
+            snap["profiler.compile_ms_total"] = led["compile_ms_total"]
+            snap["profiler.dispatches_total"] = led["dispatches_total"]
+            for job, row in led["jobs"].items():
+                snap[f"profiler.job.{job}.device_ms"] = row["device_ms"]
+                snap[f"profiler.job.{job}.compile_ms"] = row["compile_ms"]
         return snap
 
     def _trigger_savepoint(self, name: str) -> tuple[int, dict]:
@@ -225,7 +256,8 @@ class RestEndpoint:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                parts = [p for p in self.path.split("/") if p]
+                path, _, query = self.path.partition("?")
+                parts = [p for p in path.split("/") if p]
                 if parts == []:
                     from .webui import DASHBOARD_HTML
                     body = DASHBOARD_HTML.encode()
@@ -264,6 +296,16 @@ class RestEndpoint:
                     sr = endpoint._state_residency(parts[1])
                     self._reply(200 if sr else 404,
                                 sr or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "profile"):
+                    from urllib.parse import parse_qs
+                    try:
+                        top = int(parse_qs(query).get("top", ["10"])[0])
+                    except ValueError:
+                        top = 10
+                    prof = endpoint._profile(parts[1], top=top)
+                    self._reply(200 if prof else 404,
+                                prof or {"error": "no such job"})
                 elif (len(parts) == 3 and parts[0] == "jobs"
                       and parts[2] == "flight-recorder"):
                     fr = endpoint._flight_recorder(parts[1])

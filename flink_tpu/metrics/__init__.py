@@ -14,6 +14,11 @@ from .device import (
     DEVICE_STATS, DeviceStats, bind_device_metrics,
     instrumented_program_cache, pytree_nbytes, set_compile_tracer,
 )
+from .profiler import (
+    DEVICE_LEDGER, LEDGER_SITE_INVENTORY, DeviceLedger, ProgramKey,
+    bind_ledger_metrics, clear_dispatch_context, dispatch_context,
+    set_dispatch_context,
+)
 from .reporters import (
     LoggingReporter, MetricReporter, PrometheusReporter, prometheus_text,
     register_reporter, reporters_from_config,
@@ -39,4 +44,8 @@ __all__ = [
     # device-path accounting
     "DeviceStats", "DEVICE_STATS", "bind_device_metrics",
     "instrumented_program_cache", "set_compile_tracer", "pytree_nbytes",
+    # device-time ledger
+    "DeviceLedger", "DEVICE_LEDGER", "ProgramKey",
+    "LEDGER_SITE_INVENTORY", "bind_ledger_metrics",
+    "set_dispatch_context", "clear_dispatch_context", "dispatch_context",
 ]

@@ -104,6 +104,26 @@ class Histogram:
         idx = min(int(q * len(vals)), len(vals) - 1)
         return vals[idx]
 
+    # Default le-bounds for the cumulative exposition buckets: latency
+    # histograms here are milliseconds, so a 1ms..10s log-ish ladder.
+    BUCKET_BOUNDS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                     500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+    def bucket_counts(self, bounds: Optional[tuple] = None) \
+            -> list[tuple[str, int]]:
+        """Cumulative ``le``-labeled bucket counts over the reservoir
+        window, ending with ``("+Inf", count)`` — what the Prometheus
+        histogram exposition needs so external scrapers can aggregate
+        across processes (summary quantiles cannot be aggregated)."""
+        use = self.BUCKET_BOUNDS if bounds is None else tuple(bounds)
+        with self._lock:
+            vals = list(self._values)
+        out: list[tuple[str, int]] = []
+        for b in use:
+            out.append((repr(float(b)), sum(1 for v in vals if v <= b)))
+        out.append(("+Inf", len(vals)))
+        return out
+
     @property
     def count(self) -> int:
         return len(self._values)

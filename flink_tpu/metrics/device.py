@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from .profiler import DEVICE_LEDGER
+
 __all__ = ["DeviceStats", "DEVICE_STATS", "instrumented_program_cache",
            "bind_device_metrics", "set_compile_tracer", "pytree_nbytes",
            "PROGRAM_AUDIT", "ProgramAuditEntry", "clear_program_audit"]
@@ -134,6 +136,7 @@ class DeviceStats:
         if tracer is not None:
             self._finish_transfer(tracer.span("device", "H2D"),
                                   nbytes, records, ms)
+        DEVICE_LEDGER.record("transfer.h2d", ms or 0.0, nbytes=nbytes)
 
     def note_d2h(self, nbytes: int, records: int = 0,
                  ms: Optional[float] = None) -> None:
@@ -145,6 +148,7 @@ class DeviceStats:
         if tracer is not None:
             self._finish_transfer(tracer.span("device", "D2H"),
                                   nbytes, records, ms)
+        DEVICE_LEDGER.record("transfer.d2h", ms or 0.0, nbytes=nbytes)
 
     @staticmethod
     def _finish_transfer(sb, nbytes: int, records: int,
@@ -617,14 +621,25 @@ class _TimedProgram:
 
     def __call__(self, *args, **kwargs):
         if self._compiled:
-            return self._fn(*args, **kwargs)
+            if not DEVICE_LEDGER.enabled:
+                return self._fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            DEVICE_LEDGER.record(self._scope,
+                                 (time.perf_counter() - t0) * 1e3,
+                                 shape_sig=self._build_key)
+            return out
         from .tracing import now_ms
         start_ms = now_ms()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         self._compiled = True
-        DEVICE_STATS.note_compile_done(
-            self._scope, (time.perf_counter() - t0) * 1e3, start_ms)
+        ms = (time.perf_counter() - t0) * 1e3
+        DEVICE_STATS.note_compile_done(self._scope, ms, start_ms)
+        # first dispatch = trace/lower/compile: charged to the ledger as
+        # compile time, never as a steady-state dispatch sample
+        DEVICE_LEDGER.record(self._scope, ms, shape_sig=self._build_key,
+                             kind="compile")
         _record_program_audit(self._scope, self._fn, args, kwargs,
                               self._build_key)
         return out
@@ -653,6 +668,10 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
                 fire_with_retries("device.compile", scope=scope)
                 DEVICE_STATS.note_build(scope)
                 key = repr((args, tuple(sorted(kwargs.items()))))
+                # recompile attribution only — the ledger never touches
+                # DEVICE_STATS.compiles (the bench recompile budget)
+                DEVICE_LEDGER.note_build(scope, key, builder, args,
+                                         kwargs)
                 return _TimedProgram(builder(*args, **kwargs), scope,
                                      build_key=key)
 

@@ -79,7 +79,37 @@ def prometheus_text(registry: MetricRegistry) -> str:
                              f"{_prom_value(m.quantile(q))}")
             lines.append(f"{name}_sum {_prom_value(m.sum)}")
             lines.append(f"{name}_count {_prom_value(m.count)}")
+            # cumulative le-buckets over the same window: quantiles of a
+            # summary cannot be aggregated across processes, buckets can
+            lines.append(f"# TYPE {name}_bucket histogram")
+            for le, c in m.bucket_counts():
+                lines.append(f'{name}_bucket{{le="{le}"}} {c}')
+    _append_ledger_rollups(lines)
     return "\n".join(lines) + "\n"
+
+
+def _prom_label(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _append_ledger_rollups(lines: list[str]) -> None:
+    """Per-job device-time rollups from the device-time ledger, as
+    job-labeled gauges.  Only when the ledger is enabled and has
+    attributed anything — a disabled ledger adds zero scrape cost."""
+    from .profiler import DEVICE_LEDGER
+    if not DEVICE_LEDGER.enabled:
+        return
+    snap = DEVICE_LEDGER.snapshot()
+    if not snap["jobs"]:
+        return
+    for base, field in (("flink_tpu_profiler_job_device_ms", "device_ms"),
+                        ("flink_tpu_profiler_job_compile_ms", "compile_ms"),
+                        ("flink_tpu_profiler_job_dispatches", "dispatches")):
+        lines.append(f"# TYPE {base} gauge")
+        for job, row in sorted(snap["jobs"].items()):
+            lines.append(f'{base}{{job="{_prom_label(job)}"}} '
+                         f"{_prom_value(row[field])}")
 
 
 class MetricReporter:

@@ -413,13 +413,26 @@ class Tracer:
 # Chrome trace-event (Perfetto-loadable) export
 # ---------------------------------------------------------------------------
 
-def chrome_trace_events(spans: Iterable[Span], pid: int = 0) -> dict:
+def chrome_trace_events(spans: Iterable[Span], pid: int = 0,
+                        counters: Optional[Iterable[dict]] = None) -> dict:
     """Render spans as a Chrome trace-event JSON object (the ``ph: "X"``
     complete-event form) loadable in Perfetto / chrome://tracing. Scopes
     map to tids so each subsystem gets its own track; causal ids ride in
-    ``args`` for tree reconstruction."""
+    ``args`` for tree reconstruction.
+
+    ``counters`` takes device-time ledger samples
+    (``DEVICE_LEDGER.trace_counters()``: dicts with ``ts_ms``/``site``/
+    ``ms``) and renders them as ``ph: "C"`` counter tracks — one
+    ``device_ms:<site>`` series per dispatch site, alongside the span
+    tracks."""
     tids: Dict[str, int] = {}
     events: List[dict] = []
+    for c in counters or ():
+        events.append({
+            "name": f"device_ms:{c['site']}", "cat": "profiler",
+            "ph": "C", "ts": int(c["ts_ms"]) * 1000, "pid": pid,
+            "args": {"ms": round(float(c["ms"]), 4)},
+        })
     for span in spans:
         tid = tids.setdefault(span.scope, len(tids))
         args: Dict[str, Any] = {

@@ -54,12 +54,14 @@ Design points, all load-bearing:
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any
 
 import numpy as np
 
 from ..metrics.device import DEVICE_STATS, _record_program_audit
+from ..metrics.profiler import DEVICE_LEDGER
 
 __all__ = ["CHAIN_PRELUDE_SCOPE", "CHAIN_STEP_SCOPE", "shape_key",
            "FusedChain"]
@@ -197,6 +199,23 @@ class FusedChain:
                                   pargs, {}, shape_key(pargs))
             _record_program_audit(CHAIN_STEP_SCOPE, prog["chain"],
                                   args, {}, shape_key(args))
+            prog["sig"] = shape_key(args)
+            # ledger marker for the prelude program: zero-duration by
+            # design — its trace/compile cost is paid inside the first
+            # fused-step dispatch, which is charged below
+            DEVICE_LEDGER.record("chain.fused_prelude", 0.0,
+                                 shape_sig=shape_key(pargs),
+                                 kind="compile")
+        timed = DEVICE_LEDGER.enabled
+        t0 = time.perf_counter() if timed else 0.0
         out = prog["chain"](*args)
+        if timed:
+            # the first dispatch traces/lowers/compiles synchronously:
+            # charge it as compile time, not a steady-state sample
+            DEVICE_LEDGER.record(
+                "chain.fused_step", (time.perf_counter() - t0) * 1e3,
+                shape_sig=prog.get("sig", ""),
+                kind="dispatch" if prog.get("compiled") else "compile")
+        prog["compiled"] = True
         DEVICE_STATS.note_chain_dispatch()
         return out

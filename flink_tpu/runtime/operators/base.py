@@ -22,6 +22,7 @@ from ...core.config import Configuration, PipelineOptions, StateOptions
 from ...core.elements import LatencyMarker, Watermark
 from ...core.keygroups import KeyGroupRange, key_group_range_for_operator
 from ...core.records import RecordBatch, Schema
+from ...metrics.profiler import DEVICE_LEDGER, set_dispatch_context
 from ...state.backend import KeyedStateBackend, OperatorStateBackend, \
     create_backend
 from ..timers import InternalTimerService
@@ -123,11 +124,17 @@ class StreamOperator:
         self.current_watermark: int = -(1 << 62)
         self._latency_hist = None
         self.latency_markers_seen = 0
+        self._ledger_job = ""
+        self._ledger_ident = self.name
 
     # -- lifecycle ---------------------------------------------------------
     def setup(self, ctx: OperatorContext, output: Output) -> None:
         self.ctx = ctx
         self.output = output
+        # device-time ledger attribution identity: the owning job's name
+        # plus the chain-stable operator key (see OperatorChain)
+        self._ledger_job = str(ctx.config.get(PipelineOptions.NAME))
+        self._ledger_ident = getattr(self, "_op_key", self.name)
         metrics = getattr(ctx, "metrics", None)
         if metrics is not None and hasattr(metrics, "operator_group"):
             # per-operator scope (reference AbstractStreamOperator's
@@ -143,6 +150,14 @@ class StreamOperator:
         if self.current_watermark <= -(1 << 61):
             return float("nan")
         return max(0, int(time.time() * 1000) - self.current_watermark)
+
+    def _enter_dispatch(self) -> None:
+        """Pin this operator as the (job, operator) owner of device-time
+        ledger samples recorded on the current thread — called at every
+        batch/watermark entry into the operator. One attribute read when
+        the ledger is disabled."""
+        if DEVICE_LEDGER.enabled:
+            set_dispatch_context(self._ledger_job, self._ledger_ident)
 
     def initialize_state(self, keyed_snapshots: list, operator_snapshot) -> None:
         pass
@@ -234,9 +249,11 @@ class _ChainingOutput(Output):
 
     def emit(self, batch: RecordBatch) -> None:
         if batch.n:
+            self._op._enter_dispatch()
             self._op.process_batch(batch)
 
     def emit_watermark(self, watermark: Watermark) -> None:
+        self._op._enter_dispatch()
         self._op.process_watermark(watermark)
 
     def emit_latency_marker(self, marker: LatencyMarker) -> None:
@@ -284,21 +301,25 @@ class OperatorChain:
             op.open()
 
     def process_batch(self, batch: RecordBatch) -> None:
+        self.head._enter_dispatch()
         self.head_one_input.process_batch(batch)
 
     def process_batch_n(self, input_index: int, batch: RecordBatch) -> None:
         """Route a batch to input 0/1 of a two-input head."""
         head: TwoInputOperator = self.head  # type: ignore[assignment]
+        head._enter_dispatch()
         if input_index == 0:
             head.process_batch1(batch)
         else:
             head.process_batch2(batch)
 
     def process_watermark(self, watermark: Watermark) -> None:
+        self.head._enter_dispatch()
         self.head.process_watermark(watermark)
 
     def process_watermark_n(self, input_index: int,
                             watermark: Watermark) -> None:
+        self.head._enter_dispatch()
         if isinstance(self.head, TwoInputOperator):
             self.head.process_watermark_n(input_index, watermark)
         else:

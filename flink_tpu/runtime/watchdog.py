@@ -194,6 +194,18 @@ class Watchdog:
         d = self.deadline_for(site) if deadline is None else deadline
         if not self.enabled or d is None or d <= 0:
             return fn()
+        # the supervised worker is a fresh thread: re-pin the caller's
+        # (job, operator) dispatch context so device-time ledger samples
+        # recorded inside fn keep their attribution across the hop
+        from ..metrics.profiler import dispatch_context, set_dispatch_context
+        job, operator = dispatch_context()
+        if job or operator:
+            inner = fn
+
+            def fn():
+                set_dispatch_context(job, operator)
+                return inner()
+
         call = _Call(fn)
         worker = threading.Thread(target=call.execute,
                                   name=f"watchdog:{site}", daemon=True)

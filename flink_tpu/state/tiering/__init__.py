@@ -21,12 +21,12 @@ spill tier started with.
 from .policy import TieringPolicy
 from .prefetch import PrefetchPipeline
 from .residency import (
-    RESIDENCY_REGISTRY, ResidencyManager, register_residency,
-    residency_table, unregister_residency,
+    RESIDENCY_REGISTRY, ResidencyManager, hit_ratio_series,
+    register_residency, residency_table, unregister_residency,
 )
 
 __all__ = [
     "TieringPolicy", "PrefetchPipeline", "ResidencyManager",
     "RESIDENCY_REGISTRY", "register_residency", "unregister_residency",
-    "residency_table",
+    "residency_table", "hit_ratio_series",
 ]

@@ -25,6 +25,7 @@ residency/heat table of a running job.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -35,6 +36,10 @@ from .policy import TieringPolicy, stage_name
 # Upper bound on groups promoted per boundary: keeps each staging gather
 # and fixed-capacity insert small enough to stay boundary-amortized.
 MAX_PROMOTIONS_PER_BOUNDARY = 16
+
+# Per-boundary hit-ratio samples retained per manager: enough to see a
+# whole tiny/TIERED bench run's trajectory without unbounded growth.
+HIT_RATIO_WINDOW = 64
 
 
 class ResidencyManager:
@@ -59,6 +64,13 @@ class ResidencyManager:
         self.evicted_groups = 0
         self.promoted_groups = 0
         self.boundaries = 0
+        # per-boundary hot-hit-ratio time series: touches accumulate
+        # between boundaries, each boundary seals one sample into the
+        # bounded ring (the TIERED 10x-vs-100x anomaly is only visible
+        # as a trajectory, not in the run-wide cumulative ratio)
+        self._window_hot = 0
+        self._window_total = 0
+        self._hit_ratio_series: deque = deque(maxlen=HIT_RATIO_WINDOW)
 
     # ------------------------------------------------------------------
     # observations (fed by the backend)
@@ -77,6 +89,8 @@ class ResidencyManager:
                 hot = total
             else:
                 hot = int(counts[~spilled_mask[uniq]].sum())
+            self._window_hot += hot
+            self._window_total += total
             DEVICE_STATS.note_tier_touches(hot, total)
 
     def adopt_clock(self, clock: np.ndarray,
@@ -91,13 +105,27 @@ class ResidencyManager:
                 hot = total
             else:
                 hot = int((advanced & ~spilled_mask).sum())
+            self._window_hot += hot
+            self._window_total += total
             DEVICE_STATS.note_tier_touches(hot, total)
 
     def on_boundary(self) -> bool:
-        """Advance the decay cadence at a checkpoint/fire boundary."""
+        """Advance the decay cadence at a checkpoint/fire boundary; seals
+        the boundary's hot-hit-ratio sample into the bounded ring."""
         with self._lock:
             self.boundaries += 1
+            if self._window_total:
+                self._hit_ratio_series.append(
+                    round(self._window_hot / self._window_total, 4))
+                self._window_hot = 0
+                self._window_total = 0
             return self.policy.on_boundary()
+
+    def hit_ratio_series(self) -> List[float]:
+        """Per-boundary hot-tier hit ratios, oldest first (last
+        ``HIT_RATIO_WINDOW`` boundaries that saw any touches)."""
+        with self._lock:
+            return list(self._hit_ratio_series)
 
     # ------------------------------------------------------------------
     # decisions (answered to the backend)
@@ -213,3 +241,15 @@ def residency_table(name: Optional[str] = None) -> List[dict]:
         for row in manager.table_rows():
             rows.append({"operator": key, **row})
     return rows
+
+
+def hit_ratio_series(name: Optional[str] = None) -> Dict[str, List[float]]:
+    """Per-boundary hot-hit-ratio series per registered manager (same
+    substring matching + fall-back semantics as ``residency_table``)."""
+    with _REGISTRY_LOCK:
+        items = list(RESIDENCY_REGISTRY.items())
+    if name:
+        matched = [(k, m) for k, m in items if str(name) in k]
+        if matched:
+            items = matched
+    return {key: manager.hit_ratio_series() for key, manager in items}

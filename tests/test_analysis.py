@@ -193,6 +193,34 @@ def test_seeded_config_key_typo_detected(tmp_path):
     assert "checkpoint.intervall" in findings[0].message
 
 
+def test_seeded_rogue_ledger_site_detected(tmp_path):
+    """A DEVICE_LEDGER.record / instrumented_program_cache site literal
+    that is not in LEDGER_SITE_INVENTORY is flagged with rule TPU305 at
+    the recording line; inventoried sites are not (the mini package
+    still yields inventoried-not-in-code noise for the real inventory,
+    so assert membership, not the exact finding list)."""
+    ctx = _mini_pkg(tmp_path, {
+        "disp.py": """\
+            from .led import DEVICE_LEDGER, instrumented_program_cache
+
+            def fire(ms):
+                DEVICE_LEDGER.record("mesh.rogue_site", ms)   # line 4
+
+            build = instrumented_program_cache(
+                "device_window.step")
+            """,
+    })
+    findings = run_rules(ctx, ["TPU305"])
+    flagged = {(f.symbol, f.file, f.line) for f in findings}
+    assert ("code-not-inventoried:mesh.rogue_site",
+            "pkg/disp.py", 4) in flagged
+    # the inventoried site used by the mini package is clean, and every
+    # other inventory row is reported as missing from this package
+    symbols = {f.symbol for f in findings}
+    assert "code-not-inventoried:device_window.step" not in symbols
+    assert "inventoried-not-in-code:mesh.step" in symbols
+
+
 def test_seeded_unlocked_mutation_detected(tmp_path):
     """A class that guards an attribute under self._lock in one method
     but mutates it bare in another is flagged with rule TPU401."""

@@ -454,10 +454,18 @@ def _valid_trace_event_json(doc: dict) -> None:
     assert isinstance(events, list) and events
     cats = set()
     for ev in events:
-        assert ev["ph"] in ("X", "M"), ev
+        assert ev["ph"] in ("X", "M", "C"), ev
         if ev["ph"] == "M":
             assert ev["name"] == "thread_name"
             assert isinstance(ev["args"]["name"], str)
+            continue
+        if ev["ph"] == "C":
+            # device-time ledger counter tracks (one per dispatch site)
+            assert ev["name"].startswith("device_ms:")
+            assert ev["cat"] == "profiler"
+            assert isinstance(ev["ts"], int) and ev["ts"] > 0
+            assert isinstance(ev["args"]["ms"], (int, float))
+            assert ev["args"]["ms"] >= 0.0
             continue
         cats.add(ev["cat"])
         assert isinstance(ev["name"], str) and ev["name"]
