@@ -297,7 +297,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
             pane_ms: int = 2000, topk: int = 1000, device: bool = True,
             batch: int = BATCH, metrics_registry=None,
             extra_config: dict = None, fire_mode: str = "full",
-            window_panes: int = 5):
+            window_panes: int = 5, job_name: str = "nexmark-q5"):
     """One env.execute() of the Q5 pipeline; returns (wall_seconds,
     fire_latencies_ms, emitted_rows, stage_breakdown). The stage
     breakdown embeds the device-path metrics snapshot (compiles, cache
@@ -365,7 +365,7 @@ def _run_q5(n_keys: int, n_events: int, capacity: int,
                           defer_overflow=True, async_fire=True)
         .add_sink(sink.fn, "count"))
     t0 = time.perf_counter()
-    env.execute("nexmark-q5", timeout=1800.0,
+    env.execute(job_name, timeout=1800.0,
                 metrics_registry=metrics_registry)
     wall = time.perf_counter() - t0
     ops = _find_ops(env, DeviceWindowAggOperator)
@@ -398,7 +398,8 @@ def bench_framework_q5(n_keys: int, n_events: int, capacity: int,
 def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
                 n_batches: int = 8, metrics_registry=None,
                 chaos_seed=None, extra_config: dict = None,
-                fire_mode: str = "full", window_panes: int = 5) -> dict:
+                fire_mode: str = "full", window_panes: int = 5,
+                job_name: str = "nexmark-q5") -> dict:
     """Tiny Q5 acceptance probe (tier-1 safe, no backend subprocess
     probe): warmup + timed run on whatever backend jax already has;
     returns the timed run's stage report with the embedded metrics
@@ -424,21 +425,28 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
                  # hangs so the chaos run exercises the watchdog
                  # stall->retry path (watchdog_trips_total > 0)
                  "watchdog.transfer-timeout": 0.012,
+                 # the admission gate only visits its sched.* sites when
+                 # isolation is on; a solo job is never throttled, so the
+                 # gate adds the CHAOS_SPEC sched trips and nothing else
+                 "isolation.enabled": True,
                  "state.backend.tpu.host-index": False})
+        from flink_tpu.cluster.isolation import ISOLATION
         from flink_tpu.runtime.faults import FAULTS
         from flink_tpu.runtime.watchdog import WATCHDOG
         FAULTS.reset()  # arm fresh: visit counters start at zero
         WATCHDOG.reset()
+        ISOLATION.reset()  # per-job shed/reject counters start at zero
     _run_q5(n_keys, max(4 * batch, batch), 1 << 14, batch=batch,
             metrics_registry=metrics_registry, extra_config=warm_extra,
-            fire_mode=fire_mode,
-            window_panes=window_panes)                      # compile warmup
+            fire_mode=fire_mode, window_panes=window_panes,
+            job_name=job_name)                              # compile warmup
     wall, lat, rows, stages = _run_q5(n_keys, n_events, 1 << 14,
                                       batch=batch,
                                       metrics_registry=metrics_registry,
                                       extra_config=extra,
                                       fire_mode=fire_mode,
-                                      window_panes=window_panes)
+                                      window_panes=window_panes,
+                                      job_name=job_name)
     stages["wall"] = wall
     stages["events_per_sec"] = round(n_events / wall, 2)
     stages["p99_fire_latency_ms"] = round(_p99(lat), 3)
@@ -449,8 +457,18 @@ def run_tiny_q5(n_keys: int = 1000, batch: int = 1 << 12,
         stages["chaos_seed"] = int(chaos_seed)
         stages["chaos_trips"] = FAULTS.snapshot()["trips"]
         stages["watchdog_trips"] = dict(WATCHDOG.trips)
+        # per-job bulkhead deltas (counters started at zero above): what
+        # the admission gate rejected, tripped, and shed this run
+        from flink_tpu.cluster.isolation import ISOLATION
+        stages["isolation"] = {
+            job: {"admissions_rejected_total":
+                  row["admissions_rejected_total"],
+                  "bulkhead_trips_total": row["bulkhead_trips_total"],
+                  "shed_records_total": row["shed_records_total"]}
+            for job, row in ISOLATION.snapshot()["jobs"].items()}
         FAULTS.reset()
         WATCHDOG.reset()
+        ISOLATION.reset()
     return stages
 
 
@@ -471,7 +489,13 @@ CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
               # tiered-state sites: no-ops unless the run sets an HBM
               # budget (--tiered does; mid-window evict/prefetch parity
               # is asserted exactly in tests/test_tiering.py)
-              "tier.evict=once@2,tier.prefetch=once@2")
+              "tier.evict=once@2,tier.prefetch=once@2,"
+              # admission-gate sites (visited when isolation.enabled,
+              # which the chaos config sets): a bounded hang at the gate
+              # plus one forced shed to the dead-letter output — the
+              # two-tenant starvation drills are asserted exactly in
+              # tests/test_isolation.py
+              "sched.admit=every@7!hang@5,sched.shed=once@4")
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -1447,6 +1471,56 @@ def chaos(seed: int) -> None:
     sys.stdout.flush()
 
 
+def two_jobs(batch: int = 1 << 12, n_batches: int = 8) -> None:
+    """`python bench.py --two-jobs`: two tiny Q5 jobs run CONCURRENTLY
+    under the isolation scheduler (equal weights), after a solo baseline
+    pass of each; one JSON line reporting per-job events/sec, the
+    concurrent/solo ratio, and each tenant's quota/bulkhead counters.
+    The fairness surface: with equal weights both ratios should land
+    near each other (each tenant pays for sharing, neither starves)."""
+    import threading as _threading
+
+    from flink_tpu.cluster.isolation import ISOLATION
+
+    probe = _ensure_backend()
+    _emit_probe(probe)
+    iso_cfg = {"isolation.enabled": True}
+    names = ("tenant-a", "tenant-b")
+    solo = {}
+    for name in names:
+        ISOLATION.reset()
+        st = run_tiny_q5(batch=batch, n_batches=n_batches,
+                         extra_config=dict(iso_cfg), job_name=name)
+        solo[name] = st["events_per_sec"]
+    ISOLATION.reset()
+    results: dict = {}
+
+    def _run(name: str) -> None:
+        results[name] = run_tiny_q5(batch=batch, n_batches=n_batches,
+                                    extra_config=dict(iso_cfg),
+                                    job_name=name)
+
+    threads = [_threading.Thread(target=_run, args=(n,), daemon=True)
+               for n in names]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    quotas = ISOLATION.snapshot()["jobs"]
+    ISOLATION.reset()
+    rec = {"metric": "nexmark_q5_two_jobs", "unit": "report", "jobs": {}}
+    for name in names:
+        eps = results[name]["events_per_sec"]
+        rec["jobs"][name] = {
+            "events_per_sec": eps,
+            "solo_events_per_sec": solo[name],
+            "vs_solo": (round(eps / solo[name], 3) if solo[name] else 0.0),
+            "recompiles": results[name].get("recompiles", 0),
+            "quota": quotas.get(name, {})}
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
 def tiered(budget_slots: int = 1 << 10, batch: int = 1 << 12,
            n_batches: int = 8) -> None:
     """`python bench.py --tiered`: key-cardinality sweep of the tiny Q5
@@ -1570,5 +1644,7 @@ if __name__ == "__main__":
     elif "--chaos" in sys.argv:
         i = sys.argv.index("--chaos")
         chaos(int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 0)
+    elif "--two-jobs" in sys.argv:
+        two_jobs()
     else:
         main(breakdown="--breakdown" in sys.argv)

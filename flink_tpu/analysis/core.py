@@ -136,6 +136,7 @@ class AnalysisSettings:
         ("FLIGHT_RECORDER", ("FLIGHT_RECORDER", "TRACER")),
         ("MESH_RUNTIME", ("MESH_RUNTIME",)),
         ("DEVICE_LEDGER", ("DEVICE_LEDGER",)),
+        ("ISOLATION", ("ISOLATION",)),
     )
     # Determinism rule: span/tracing modules where time.time() is banned
     # (monotonic-anchored clock only — see now_ms() in metrics/tracing).

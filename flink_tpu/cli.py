@@ -389,6 +389,65 @@ def _cmd_profile(args) -> int:
     return 0
 
 
+def _cmd_jobs(args) -> int:
+    """Print the per-job quota/bulkhead table (``--quotas``): fair-share
+    weight, remaining deficit, device-time share from the ledger,
+    breaker state, and the rejected/shed counters. Fetches
+    ``/jobs/<name>/quota`` for each job on a running endpoint, or falls
+    back to THIS process's isolation scheduler when no ``--target`` is
+    given (useful right after an in-process multi-job run)."""
+    import json as _json
+    import urllib.request
+
+    if args.target:
+        base = f"http://{args.target}"
+        try:
+            with urllib.request.urlopen(f"{base}/jobs",
+                                        timeout=10.0) as resp:
+                overview = _json.loads(resp.read().decode())
+        except OSError as e:
+            print(f"jobs: cannot fetch {base}/jobs: {e}", file=sys.stderr)
+            return 1
+        if isinstance(overview, dict):
+            overview = overview.get("jobs", [])
+        names = [j.get("name") for j in overview if j.get("name")]
+        views = []
+        for name in names:
+            try:
+                with urllib.request.urlopen(f"{base}/jobs/{name}/quota",
+                                            timeout=10.0) as resp:
+                    views.append(_json.loads(resp.read().decode()))
+            except OSError as e:
+                print(f"jobs: cannot fetch quota for {name}: {e}",
+                      file=sys.stderr)
+                return 1
+        enabled = any(v.get("enabled") for v in views)
+        views = [v for v in views if v.get("job")]
+    else:
+        from .cluster.isolation import ISOLATION
+        snap = ISOLATION.snapshot()
+        enabled = snap["enabled"]
+        views = list(snap["jobs"].values())
+    if not args.quotas:
+        _print_table(["job"], [[v["job"]] for v in views],
+                     max_rows=args.max_rows)
+        return 0
+    if not enabled:
+        print("isolation is disabled (run with isolation.enabled: true)")
+    if not views:
+        print("no jobs registered with the isolation scheduler")
+        return 0
+    rows = [[v["job"], v["weight"], v["deficit"],
+             f"{v['device_time_share'] * 100:.1f}%", v["breaker"],
+             v["admitted_total"], v["admissions_rejected_total"],
+             v["shed_records_total"], v["bulkhead_trips_total"]]
+            for v in views]
+    _print_table(["job", "weight", "deficit", "device_share", "breaker",
+                  "admitted", "rejected", "shed_records", "trips"],
+                 rows, max_rows=args.max_rows)
+    return 0
+
+
 def _cmd_sql(args) -> int:
     """Interactive SQL client against a TableEnvironment (reference
     flink-table/flink-sql-client SqlClient.java:67): DDL mutates the
@@ -697,6 +756,18 @@ def main(argv: Optional[list[str]] = None) -> int:
     prf.add_argument("--json", action="store_true",
                      help="machine-readable payload")
     prf.set_defaults(fn=_cmd_profile)
+
+    jbs = sub.add_parser(
+        "jobs",
+        help="list jobs; --quotas adds the per-job admission-quota / "
+             "bulkhead table (weight, deficit, device share, breaker)")
+    jbs.add_argument("--quotas", action="store_true",
+                     help="show the isolation scheduler's quota columns")
+    jbs.add_argument("--target", default="",
+                     help="host:port of a REST endpoint; empty = the "
+                          "current process's isolation scheduler")
+    jbs.add_argument("--max-rows", type=int, default=50)
+    jbs.set_defaults(fn=_cmd_jobs)
 
     gwp = sub.add_parser("sql-gateway",
                          help="serve the REST SQL gateway")

@@ -731,6 +731,10 @@ class DistributedHost:
         # device-time ledger (same wiring as deploy_local)
         from ..metrics.profiler import DEVICE_LEDGER
         DEVICE_LEDGER.configure(config)
+        # multi-tenant isolation (same wiring as deploy_local)
+        from .isolation import ISOLATION
+        ISOLATION.configure(config)
+        ISOLATION.register_job(jg.name)
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "

@@ -1,0 +1,392 @@
+"""Multi-tenant isolation: per-job quotas, bulkheads, overload defense.
+
+The process-global device-time scheduler that turns the device-time
+ledger's attribution signal (metrics/profiler.py: every dispatch is
+charged to a ``(job, operator, site, shape)`` key) into enforcement
+(docs/ROBUSTNESS.md, 'Multi-tenant isolation'):
+
+* **Quotas** — micro-batch dispatch admission runs deficit-round-robin
+  over ``isolation.job-weights``: each source polls ``try_admit`` before
+  reading its next batch; under contention a job spends one credit per
+  batch and credits replenish in proportion to weight only when every
+  active demanding job has exhausted its deficit. All decisions are
+  count-based (a global admission-attempt counter, never wall-clock and
+  never random), so the admission sequence is a pure function of the
+  arrival order — deterministic per TPU501.
+
+* **Bulkheads** — each job gets its own admission bound
+  (``isolation.queue-bound``), its own failure domain (failure history,
+  flight dumps, watchdog/faults events, and REST exception surfaces are
+  job-scoped via the thread-local dispatch context), and its own
+  circuit breaker: ``isolation.breaker-failures`` consecutive failures
+  open it, a count-based cooldown (``isolation.breaker-cooldown``
+  admission attempts) later it half-opens and admits one probe.
+
+* **Shedding** — sustained overload (gate wait past
+  ``isolation.shed-after`` or an open breaker) sheds the batch to the
+  existing dead-letter side output with a typed ``OverloadShedError``:
+  never a silent drop (the records land in the quarantine the operator
+  already exposes), never a blocked healthy tenant (the shed is the
+  backpressure relief valve — see the shed-vs-backpressure table in
+  docs/ROBUSTNESS.md).
+
+Disabled (the default) every gate check is one attribute read.
+``deploy_local`` / ``DistributedHost.deploy`` configure the singleton
+from the job Configuration, like FAULTS / WATCHDOG / DEVICE_LEDGER.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["OverloadShedError", "JobBulkhead", "IsolationScheduler",
+           "ISOLATION"]
+
+#: A job counts as "active" (competing for credit) while its last
+#: admission attempt is within this many global attempts of now; an
+#: idle, finished, or wedged-and-not-polling job ages out and stops
+#: holding back replenishment for everyone else. Count-based, not
+#: wall-clock, so schedules replay deterministically.
+ACTIVE_WINDOW = 512
+
+
+class OverloadShedError(RuntimeError):
+    """A micro-batch was shed by its job's bulkhead instead of
+    dispatched. ``reason`` is one of ``breaker-open`` (circuit breaker
+    tripped by consecutive failures), ``gate-timeout`` (admission wait
+    exceeded ``isolation.shed-after``), ``bulkhead-full`` (more waiters
+    than ``isolation.queue-bound``), or ``injected`` (a ``sched.shed``
+    chaos rule tripped). The records are NOT lost: the caller emits the
+    batch on the dead-letter side output before surfacing this."""
+
+    def __init__(self, job: str, reason: str, waited_s: float = 0.0):
+        super().__init__(
+            f"job {job!r} shed a micro-batch ({reason}, waited "
+            f"{waited_s * 1e3:.0f}ms)")
+        self.job = job
+        self.reason = reason
+        self.waited_s = waited_s
+
+
+class JobBulkhead:
+    """Per-job scheduler record. Mutated only under the owning
+    scheduler's lock — it carries no lock of its own."""
+
+    __slots__ = ("name", "weight", "deficit", "last_attempt", "waiting",
+                 "admitted_total", "rejected_total", "shed_batches_total",
+                 "shed_records_total", "bulkhead_trips_total",
+                 "consecutive_failures", "failures_total",
+                 "breaker_open", "breaker_opened_at",
+                 "breaker_opens_total", "probe_inflight")
+
+    def __init__(self, name: str, weight: float):
+        self.name = name
+        self.weight = weight
+        self.deficit = weight          # one replenish-free burst at start
+        self.last_attempt = 0
+        self.waiting = 0               # batches at the gate right now
+        self.admitted_total = 0
+        self.rejected_total = 0
+        self.shed_batches_total = 0
+        self.shed_records_total = 0
+        self.bulkhead_trips_total = 0
+        self.consecutive_failures = 0
+        self.failures_total = 0
+        self.breaker_open = False
+        self.breaker_opened_at = 0     # global attempt count at open
+        self.breaker_opens_total = 0
+        self.probe_inflight = False    # half-open probe outstanding
+
+    def breaker_state(self) -> str:
+        if not self.breaker_open:
+            return "closed"
+        return "half-open" if self.probe_inflight else "open"
+
+
+class IsolationScheduler:
+    """Process-wide per-job admission scheduler + bulkhead registry.
+
+    Admission is caller-driven: each source task polls ``try_admit``
+    before reading a micro-batch and backs off ~1ms (counted as
+    backpressure) on ``"retry"``, so there is no scheduler thread and
+    no queue to drain — the bounded "queue" is the set of polling
+    callers, and ``waiting`` tracks its depth per job.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._jobs: dict[str, JobBulkhead] = {}
+        self._weights: dict[str, float] = {}
+        self._quantum = 8.0
+        self._shed_after = 5.0
+        self._breaker_failures = 8
+        self._breaker_cooldown = 64
+        self._queue_bound = 128
+        self._attempts = 0             # global admission-attempt counter
+        self._fingerprint: Optional[tuple] = None
+
+    # -- configuration ---------------------------------------------------
+    def configure(self, config) -> None:
+        """Adopt ``isolation.*`` keys from a job Configuration.
+        Idempotent on an unchanged fingerprint so failover redeploys of
+        the SAME job keep their counters and breaker state — a tripped
+        breaker must not silently close on every restart attempt."""
+        from ..core.config import IsolationOptions
+
+        enabled = bool(config.get(IsolationOptions.ENABLED))
+        weights = str(config.get(IsolationOptions.JOB_WEIGHTS) or "")
+        quantum = float(config.get(IsolationOptions.QUANTUM))
+        shed_after = float(config.get(IsolationOptions.SHED_AFTER))
+        breaker_failures = int(config.get(
+            IsolationOptions.BREAKER_FAILURES))
+        breaker_cooldown = int(config.get(
+            IsolationOptions.BREAKER_COOLDOWN))
+        queue_bound = int(config.get(IsolationOptions.QUEUE_BOUND))
+        fingerprint = (enabled, weights, quantum, shed_after,
+                       breaker_failures, breaker_cooldown, queue_bound)
+        with self._lock:
+            if fingerprint == self._fingerprint:
+                return
+            self.enabled = enabled
+            self._weights = self._parse_weights(weights)
+            self._quantum = max(1.0, quantum)
+            self._shed_after = max(0.0, shed_after)
+            self._breaker_failures = max(1, breaker_failures)
+            self._breaker_cooldown = max(1, breaker_cooldown)
+            self._queue_bound = max(1, queue_bound)
+            self._jobs.clear()
+            self._attempts = 0
+            self._fingerprint = fingerprint
+
+    @staticmethod
+    def _parse_weights(spec: str) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for entry in (spec or "").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if "=" not in entry:
+                raise ValueError(
+                    f"isolation.job-weights entry {entry!r}: expected "
+                    f"'job=weight'")
+            name, _, w = entry.partition("=")
+            if not name.strip():
+                raise ValueError(
+                    f"isolation.job-weights entry {entry!r}: empty "
+                    f"job name")
+            try:
+                weight = float(w)
+            except ValueError:
+                raise ValueError(
+                    f"isolation.job-weights entry {entry!r}: weight "
+                    f"{w!r} is not a number") from None
+            if weight <= 0.0:
+                raise ValueError(
+                    f"isolation.job-weights entry {entry!r}: weight "
+                    f"must be > 0")
+            out[name.strip()] = weight
+        return out
+
+    def reset(self) -> None:
+        """Disarm and clear all per-job state (test isolation)."""
+        with self._lock:
+            self.enabled = False
+            self._jobs.clear()
+            self._weights = {}
+            self._attempts = 0
+            self._fingerprint = None
+
+    def register_job(self, name: str) -> None:
+        """Create the job's bulkhead (idempotent — a failover redeploy
+        keeps the existing record and its breaker state)."""
+        if not name:
+            return
+        with self._lock:
+            if name not in self._jobs:
+                self._jobs[name] = JobBulkhead(
+                    name, self._weights.get(name, 1.0))
+
+    def _job_locked(self, name: str) -> JobBulkhead:
+        b = self._jobs.get(name)
+        if b is None:
+            b = self._jobs[name] = JobBulkhead(
+                name, self._weights.get(name, 1.0))
+        return b
+
+    # -- admission (the tentpole chokepoint) -----------------------------
+    def note_waiting(self, job: str, delta: int) -> None:
+        """Track gate depth: +1 when a caller starts polling for one
+        micro-batch, -1 when it admits or sheds."""
+        if not self.enabled:
+            return
+        with self._lock:
+            b = self._job_locked(job)
+            b.waiting = max(0, b.waiting + delta)
+
+    def try_admit(self, job: str, waited_s: float = 0.0) -> str:
+        """One admission attempt for the next micro-batch of ``job``.
+
+        Returns ``"admit"`` (dispatch it), ``"retry"`` (no credit under
+        contention — back off ~1ms, keep the mailbox live, poll again
+        with the accumulated wait), or a shed verdict:
+        ``"shed:breaker-open"`` / ``"shed:gate-timeout"`` /
+        ``"shed:bulkhead-full"`` — emit the batch to the dead-letter
+        side output and surface ``OverloadShedError``."""
+        if not self.enabled:
+            return "admit"
+        with self._lock:
+            b = self._job_locked(job)
+            self._attempts += 1
+            b.last_attempt = self._attempts
+            # breaker first: an open breaker sheds regardless of credit
+            if b.breaker_open:
+                cooled = (self._attempts - b.breaker_opened_at
+                          >= self._breaker_cooldown)
+                if cooled and not b.probe_inflight:
+                    # half-open: admit exactly one probe batch; its
+                    # note_success/note_failure decides the transition
+                    b.probe_inflight = True
+                    b.admitted_total += 1
+                    return "admit"
+                b.rejected_total += 1
+                return "shed:breaker-open"
+            # bulkhead bound: too many batches already at this gate
+            if b.waiting > self._queue_bound:
+                b.rejected_total += 1
+                b.bulkhead_trips_total += 1
+                return "shed:bulkhead-full"
+            # age-based shed: sustained overload, relieve the queue
+            if self._shed_after > 0.0 and waited_s >= self._shed_after:
+                b.rejected_total += 1
+                return "shed:gate-timeout"
+            # deficit-round-robin over the active set
+            active = [j for j in self._jobs.values()
+                      if self._attempts - j.last_attempt < ACTIVE_WINDOW]
+            if len(active) <= 1:
+                # solo tenant: admission is free — quotas only shape
+                # CONTENTION, a lone job must run at full speed
+                b.admitted_total += 1
+                return "admit"
+            if b.deficit >= 1.0:
+                b.deficit -= 1.0
+                b.admitted_total += 1
+                return "admit"
+            if any(j.deficit >= 1.0 for j in active if j is not b):
+                # a competitor holds credit — yield the slot to it
+                b.rejected_total += 1
+                return "retry"
+            # every active job is exhausted: replenish the whole round
+            # in weight proportion (sorted for a stable, seed-free order)
+            for j in sorted(active, key=lambda x: x.name):
+                j.deficit = min(j.deficit + j.weight * self._quantum,
+                                2.0 * j.weight * self._quantum)
+            b.deficit -= 1.0
+            b.admitted_total += 1
+            return "admit"
+
+    def note_shed(self, job: str, records: int,
+                  reason: str = "gate-timeout") -> None:
+        """Account one shed batch (``records`` rows quarantined to the
+        dead-letter output) against the job's bulkhead."""
+        if not self.enabled:
+            return
+        with self._lock:
+            b = self._job_locked(job)
+            b.shed_batches_total += 1
+            b.shed_records_total += max(0, int(records))
+            if reason == "breaker-open":
+                b.bulkhead_trips_total += 1
+
+    # -- circuit breaker -------------------------------------------------
+    def note_failure(self, job: str) -> None:
+        """One task/segment failure in ``job``'s domain (region restart,
+        poison quarantine, retries-exhausted DeviceSegmentError). Trips
+        the breaker open after ``isolation.breaker-failures``
+        consecutive failures; a half-open probe's failure re-opens."""
+        if not self.enabled or not job:
+            return
+        with self._lock:
+            b = self._job_locked(job)
+            b.failures_total += 1
+            b.consecutive_failures += 1
+            if b.breaker_open:
+                if b.probe_inflight:          # probe failed: re-open
+                    b.probe_inflight = False
+                    b.breaker_opened_at = self._attempts
+                return
+            if b.consecutive_failures >= self._breaker_failures:
+                b.breaker_open = True
+                b.probe_inflight = False
+                b.breaker_opened_at = self._attempts
+                b.breaker_opens_total += 1
+
+    def note_success(self, job: str) -> None:
+        """One healthy dispatch in ``job``: resets the consecutive-
+        failure ladder and closes a half-open breaker."""
+        if not self.enabled or not job:
+            return
+        with self._lock:
+            b = self._jobs.get(job)
+            if b is None:
+                return
+            b.consecutive_failures = 0
+            if b.breaker_open and b.probe_inflight:
+                b.breaker_open = False
+                b.probe_inflight = False
+
+    # -- views -----------------------------------------------------------
+    def _device_shares(self) -> dict[str, float]:
+        """Each job's share of total attributed device time, from the
+        device-time ledger (empty when the ledger is off)."""
+        try:
+            from ..metrics.profiler import DEVICE_LEDGER
+            jobs = DEVICE_LEDGER.snapshot().get("jobs", {})
+        except Exception:  # pragma: no cover - ledger must never break us
+            return {}
+        total = sum(row.get("device_ms", 0.0) for row in jobs.values())
+        if total <= 0.0:
+            return {}
+        return {name: round(row.get("device_ms", 0.0) / total, 4)
+                for name, row in jobs.items()}
+
+    def quota_view(self, name: str) -> Optional[dict]:
+        """One job's quota/bulkhead state for REST and the CLI."""
+        shares = self._device_shares()
+        with self._lock:
+            b = self._jobs.get(name)
+            if b is None:
+                return None
+            return self._row(b, shares)
+
+    @staticmethod
+    def _row(b: JobBulkhead, shares: dict[str, float]) -> dict:
+        return {"job": b.name,
+                "weight": b.weight,
+                "deficit": round(b.deficit, 3),
+                "waiting": b.waiting,
+                "device_time_share": shares.get(b.name, 0.0),
+                "admitted_total": b.admitted_total,
+                "admissions_rejected_total": b.rejected_total,
+                "shed_batches_total": b.shed_batches_total,
+                "shed_records_total": b.shed_records_total,
+                "bulkhead_trips_total": b.bulkhead_trips_total,
+                "failures_total": b.failures_total,
+                "consecutive_failures": b.consecutive_failures,
+                "breaker": b.breaker_state(),
+                "breaker_opens_total": b.breaker_opens_total}
+
+    def snapshot(self) -> dict:
+        shares = self._device_shares()
+        with self._lock:
+            return {"enabled": self.enabled,
+                    "attempts": self._attempts,
+                    "jobs": {name: self._row(b, shares)
+                             for name, b in sorted(self._jobs.items())}}
+
+
+#: The process-global scheduler every admission gate consults.
+#: ``deploy_local`` / ``DistributedHost.deploy`` configure it from the
+#: job Configuration.
+ISOLATION = IsolationScheduler()

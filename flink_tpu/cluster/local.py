@@ -115,9 +115,14 @@ class LocalJob(TaskReporter):
             self._failed.append((task_id, error))
             self.failure_history.append({
                 "timestamp": time.time(), "task": task_id,
-                "kind": "task-failure",
+                "job": self.job_graph.name, "kind": "task-failure",
                 "error": f"{type(error).__name__}: {error}"})
             self._done.set()
+        # feed the owning job's circuit breaker — a task failure is one
+        # consecutive-failure step toward its bulkhead shedding instead
+        # of restarting forever (cluster/isolation.py)
+        from .isolation import ISOLATION
+        ISOLATION.note_failure(self.job_graph.name)
 
     # -- control -----------------------------------------------------------
     def start(self) -> None:
@@ -193,6 +198,12 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     # attribution (off by default — profiler.enabled)
     from ..metrics.profiler import DEVICE_LEDGER
     DEVICE_LEDGER.configure(config)
+    # multi-tenant isolation: per-job admission quotas + bulkheads are
+    # process-global for the same reason — every job sharing the device
+    # pool must meter against the same scheduler (off by default)
+    from .isolation import ISOLATION
+    ISOLATION.configure(config)
+    ISOLATION.register_job(job_graph.name)
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape

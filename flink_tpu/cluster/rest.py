@@ -105,14 +105,26 @@ class RestEndpoint:
                  "tasks": stats.get(c.checkpoint_id, {}).get("tasks")}
                 for c in getattr(coord, "_completed", [])]
 
+    @staticmethod
+    def _job_scoped(events, name: str):
+        """Bulkhead filter for process-global event streams: a job's
+        exception surface shows its OWN events plus unattributed ones
+        (pre-task plumbing with no dispatch context) — never another
+        tenant's failures (docs/ROBUSTNESS.md, 'Multi-tenant
+        isolation')."""
+        return (dict(e) for e in events
+                if not e.get("job") or e.get("job") == name)
+
     def _exceptions(self, name: str) -> Optional[dict]:
         """Bounded failure history (the reference's JobExceptionsHandler /
         exception-history endpoint): task failures, restart decisions,
         degradations, stall detections — newest first — plus any failed
         checkpoint writes from the coordinator's stats and the process-
-        global watchdog's stall events (deadline expiries absorbed by
-        retry or the degradation ladder never reach a task failure, but
-        the operator debugging a slow job still needs to see them)."""
+        global watchdog's stall and fault-injection events (deadline
+        expiries absorbed by retry or the degradation ladder never reach
+        a task failure, but the operator debugging a slow job still
+        needs to see them). All process-global streams are job-scoped:
+        one tenant's damage never appears under another's name."""
         job = self._jobs.get(name)
         if job is None:
             return None
@@ -125,12 +137,12 @@ class RestEndpoint:
                                 "checkpoint": s.get("id"),
                                 "error": s.get("error")})
         from ..runtime.watchdog import WATCHDOG
-        entries.extend(dict(e) for e in WATCHDOG.events)
+        entries.extend(self._job_scoped(WATCHDOG.events, name))
         # transport-plane events (reconnects, fenced zombies, socket
         # errors the accept/receive/credit paths used to swallow): the
         # operator diagnosing a flapping partition sees them here
         from .transport import NET_EVENTS
-        entries.extend(dict(e) for e in NET_EVENTS)
+        entries.extend(self._job_scoped(NET_EVENTS, name))
         entries.sort(key=lambda e: e.get("timestamp") or 0, reverse=True)
         return {"name": name, "entries": entries}
 
@@ -185,8 +197,23 @@ class RestEndpoint:
             return None
         from ..metrics.tracing import FLIGHT_RECORDER
         return {"name": name,
-                "dumps": list(FLIGHT_RECORDER.dumps),
+                "dumps": list(self._job_scoped(FLIGHT_RECORDER.dumps,
+                                               name)),
                 "recent": FLIGHT_RECORDER.snapshot()[-64:]}
+
+    def _quota(self, name: str) -> Optional[dict]:
+        """One job's admission-quota/bulkhead view (cluster/isolation.py):
+        weight, deficit, device-time share, breaker state, and the
+        rejected/shed counters. Valid-but-inactive jobs report
+        ``{"enabled": False}`` when isolation is off."""
+        if name not in self._jobs:
+            return None
+        from .isolation import ISOLATION
+        view = ISOLATION.quota_view(name)
+        if view is None:
+            return {"name": name, "enabled": ISOLATION.enabled}
+        view["enabled"] = ISOLATION.enabled
+        return view
 
     def _metrics_registry(self):
         """The bound registry, or a lazily-created one carrying only the
@@ -226,6 +253,20 @@ class RestEndpoint:
             for job, row in led["jobs"].items():
                 snap[f"profiler.job.{job}.device_ms"] = row["device_ms"]
                 snap[f"profiler.job.{job}.compile_ms"] = row["compile_ms"]
+        # multi-tenant quota/bulkhead gauges when isolation is on: the
+        # per-job device-time share, breaker state (0 closed / 1 open or
+        # half-open), and the rejection/shed counters
+        from .isolation import ISOLATION
+        if ISOLATION.enabled:
+            for job, row in ISOLATION.snapshot()["jobs"].items():
+                pre = f"isolation.job.{job}"
+                snap[f"{pre}.device_time_share"] = row["device_time_share"]
+                snap[f"{pre}.breaker_open"] = int(row["breaker"] != "closed")
+                snap[f"{pre}.admissions_rejected_total"] = \
+                    row["admissions_rejected_total"]
+                snap[f"{pre}.shed_records_total"] = row["shed_records_total"]
+                snap[f"{pre}.bulkhead_trips_total"] = \
+                    row["bulkhead_trips_total"]
         return snap
 
     def _trigger_savepoint(self, name: str) -> tuple[int, dict]:
@@ -311,6 +352,11 @@ class RestEndpoint:
                     fr = endpoint._flight_recorder(parts[1])
                     self._reply(200 if fr else 404,
                                 fr or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "quota"):
+                    q = endpoint._quota(parts[1])
+                    self._reply(200 if q else 404,
+                                q or {"error": "no such job"})
                 elif parts == ["metrics", "snapshot"]:
                     self._reply(200, endpoint._metrics_snapshot())
                 elif parts == ["metrics"]:

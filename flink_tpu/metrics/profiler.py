@@ -618,6 +618,10 @@ LEDGER_SITE_INVENTORY: tuple = (
      "parallel/sharded_window.py — sharded per-batch ingest program"),
     ("ops.pallas_topk",
      "ops/pallas_topk.py — top-k selection kernel"),
+    ("sched.throttle",  # lint: key-ok ledger site, not a config key
+     "runtime/stream_task.py _admission_gate — wall time a micro-batch "
+     "waited at the per-job admission gate before dispatch (quota "
+     "pressure, charged to the throttled job)"),
     ("sql.device_group_agg",
      "sql/device_group_agg.py — SQL grouped-aggregation program"),
     ("state.reset_row",

@@ -466,10 +466,23 @@ TRACER.add_reporter(InMemoryTraceReporter())
 TRACER.add_reporter(FLIGHT_RECORDER)
 
 
+def _owning_job(fields: dict) -> dict:
+    """Ensure every flight event/dump names its owning job: callers that
+    know it pass ``job=...`` explicitly; for the rest the thread-local
+    dispatch context (pinned at task-thread start) fills it in, so
+    multi-tenant post-mortems can split one ring by failure domain."""
+    if not fields.get("job"):
+        from .profiler import dispatch_context
+        job = dispatch_context()[0]
+        if job:
+            fields = dict(fields, job=job)
+    return fields
+
+
 def record_flight_event(kind: str, **fields: Any) -> None:
     """Append a discrete (non-span) event to the flight-recorder ring."""
     try:
-        FLIGHT_RECORDER.record_event(kind, **fields)
+        FLIGHT_RECORDER.record_event(kind, **_owning_job(fields))
     except Exception:  # noqa: BLE001 - observability must not kill jobs
         pass
 
@@ -478,6 +491,7 @@ def dump_flight_recorder(reason: str, **fields: Any) -> Optional[str]:
     """Record ``reason`` as an event, then dump the ring to a file.
     Called from the fault chokepoints; never raises."""
     try:
+        fields = _owning_job(fields)
         FLIGHT_RECORDER.record_event(reason, **fields)
         return FLIGHT_RECORDER.dump(reason, **fields)
     except Exception:  # noqa: BLE001 - observability must not kill jobs
@@ -537,6 +551,12 @@ SPAN_INVENTORY: tuple = (
     ("restore", "Restore",
      "checkpoint/coordinator.py latest_verified_checkpoint — verified "
      "restore-candidate selection"),
+    ("sched", "Admit",
+     "runtime/stream_task.py _admission_gate — quota-throttled "
+     "micro-batch admission (span covers the gate wait)"),
+    ("sched", "Shed",
+     "runtime/stream_task.py _admission_gate — overloaded micro-batch "
+     "quarantined to the dead-letter output"),
     ("task", "SourceBatch",
      "runtime/stream_task.py — one source read→emit mailbox cycle"),
     ("tier", "Evict",

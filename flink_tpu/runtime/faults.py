@@ -36,11 +36,23 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     net.zombie        drop-style: suppress a worker's heartbeats AND its
                       control-reconnect reflex while tasks and data keep
                       flowing (the partitioned-but-alive split-brain)
+    sched.admit       the per-job admission gate sources poll before
+                      reading a micro-batch (cluster/isolation.py)
+    sched.shed        drop-style: force the admission gate to shed the
+                      next micro-batch to the dead-letter output even
+                      without real overload
 
 Every rule also accepts a ``!hang@MS`` flag: the trip SLEEPS MS
 milliseconds at the site instead of raising — the deterministic stand-in
 for a wedged call, surfaced by the stall watchdog's per-site deadline
 (runtime/watchdog.py) rather than by an exception.
+
+A ``!job@NAME`` flag scopes a rule to one tenant: it only trips when the
+thread-local dispatch context (metrics/profiler.py) attributes the visit
+to job NAME, and it counts visits per ``site!job@NAME`` stream — the
+multi-job isolation drill poisons or hangs job A's dispatches without
+touching job B's. A site may carry several comma-separated rules (e.g.
+one per job); unfiltered single-rule specs behave exactly as before.
 
 ``DeviceGuard`` is the reflex around every compiled-segment call:
 transient failures retry with exponential backoff (reusing the
@@ -75,6 +87,7 @@ FAULT_SITES = (
     "tier.evict", "tier.prefetch",
     "bench.probe",
     "net.connect", "net.sever", "net.delay", "net.zombie",
+    "sched.admit", "sched.shed",
 )
 
 
@@ -129,6 +142,7 @@ class FaultRule:
     transient: bool = True
     poison: bool = False
     hang_ms: int = 0     # >0: the trip SLEEPS this long instead of raising
+    job: str = ""        # non-empty: only trips for this dispatch-context job
 
     @staticmethod
     def parse(entry: str) -> "FaultRule":
@@ -143,6 +157,7 @@ class FaultRule:
         parts = mode.strip().split("!")
         mode, flags = parts[0].strip(), {f.strip() for f in parts[1:]}
         hang_ms = 0
+        job = ""
         for f in list(flags):
             if f.startswith("hang@"):
                 flags.discard(f)
@@ -150,12 +165,19 @@ class FaultRule:
                 if hang_ms < 1:
                     raise ValueError(
                         f"fault rule {entry!r}: hang@MS needs MS>=1")
+            elif f.startswith("job@"):
+                flags.discard(f)
+                job = f[4:]
+                if not job:
+                    raise ValueError(
+                        f"fault rule {entry!r}: job@NAME needs a name")
         bad = flags - {"persistent", "transient", "poison"}
         if bad:
             raise ValueError(f"fault rule {entry!r}: unknown flags {bad}")
         rule = FaultRule(site, "off",
                          transient="persistent" not in flags,
-                         poison="poison" in flags, hang_ms=hang_ms)
+                         poison="poison" in flags, hang_ms=hang_ms,
+                         job=job)
         if mode in ("off", ""):
             rule.mode = "off"
         elif mode == "always":
@@ -193,7 +215,7 @@ class FaultInjector:
         self._lock = threading.Lock()
         self.enabled = False
         self.seed = 0
-        self._rules: dict[str, FaultRule] = {}
+        self._rules: dict[str, list[FaultRule]] = {}
         self._visits: dict[str, int] = {}
         self._trips: dict[str, int] = {}
         self._rngs: dict[str, random.Random] = {}
@@ -222,12 +244,12 @@ class FaultInjector:
 
     def configure_spec(self, spec: str, seed: int = 0,
                        enabled: bool = True) -> None:
-        rules = {}
+        rules: dict[str, list[FaultRule]] = {}
         for entry in (spec or "").split(","):
             if not entry.strip():
                 continue
             rule = FaultRule.parse(entry)
-            rules[rule.site] = rule
+            rules.setdefault(rule.site, []).append(rule)
         with self._lock:
             self._rules = rules
             self.seed = seed
@@ -269,37 +291,69 @@ class FaultInjector:
 
     # -- the hot check ---------------------------------------------------
     def _trip(self, site: str) -> Optional[InjectedFault]:
+        from ..metrics.profiler import dispatch_context
+
+        ctx_job = dispatch_context()[0]
         with self._lock:
             if self._suppress:
                 return None
-            rule = self._rules.get(site)
-            if rule is None or rule.mode == "off":
+            rules = self._rules.get(site)
+            if not rules:
                 return None
             visit = self._visits.get(site, 0) + 1
             self._visits[site] = visit
-            if rule.mode == "once":
-                hit = visit == rule.at
-            elif rule.mode == "every":
-                hit = visit % rule.at == 0
-            elif rule.mode == "always":
-                hit = True
-            else:  # prob
-                rng = self._rngs.get(site)
-                if rng is None:
-                    rng = self._rngs[site] = random.Random(
-                        f"{self.seed}:{site}")
-                hit = rng.random() < rule.p
-            if not hit:
+            # job-filtered rules count visits on their own per-tenant
+            # stream (site!job@NAME) so every@N means "every Nth visit
+            # BY that job"; at most one bump per stream per visit even
+            # with several rules on it
+            bumped: dict[str, int] = {site: visit}
+
+            def stream_visit(key: str) -> int:
+                if key not in bumped:
+                    bumped[key] = self._visits.get(key, 0) + 1
+                    # lint: lock-ok closure only called in the locked block
+                    self._visits[key] = bumped[key]
+                return bumped[key]
+
+            hit_rule, hit_visit = None, visit
+            for rule in rules:
+                if rule.mode == "off":
+                    continue
+                if rule.job:
+                    if ctx_job != rule.job:
+                        continue
+                    key = f"{site}!job@{rule.job}"
+                    rvisit = stream_visit(key)
+                else:
+                    key, rvisit = site, visit
+                if rule.mode == "once":
+                    hit = rvisit == rule.at
+                elif rule.mode == "every":
+                    hit = rvisit % rule.at == 0
+                elif rule.mode == "always":
+                    hit = True
+                else:  # prob
+                    rng = self._rngs.get(key)
+                    if rng is None:
+                        rng = self._rngs[key] = random.Random(
+                            f"{self.seed}:{key}")
+                    hit = rng.random() < rule.p
+                if hit:
+                    hit_rule, hit_visit = rule, rvisit
+                    break
+            if hit_rule is None:
                 return None
+            rule = hit_rule
             self._trips[site] = self._trips.get(site, 0) + 1
             if len(self.events) < 4096:
-                self.events.append({"site": site, "visit": visit,
+                self.events.append({"site": site, "visit": hit_visit,
                                     "transient": rule.transient,
                                     "poison": rule.poison,
-                                    "hang_ms": rule.hang_ms})
+                                    "hang_ms": rule.hang_ms,
+                                    "job": rule.job or ctx_job})
         from ..metrics.device import DEVICE_STATS
         DEVICE_STATS.note_injected(site)
-        return InjectedFault(site, visit, transient=rule.transient,
+        return InjectedFault(site, hit_visit, transient=rule.transient,
                              poison=rule.poison, hang_ms=rule.hang_ms)
 
     def _hang(self, fault: InjectedFault) -> None:
@@ -431,6 +485,22 @@ class DeviceGuard:
         self.failures = 0
         self.stalls = 0       # watchdog deadline expiries seen here
 
+    @staticmethod
+    def _note_breaker(success: bool) -> None:
+        """Feed the owning job's circuit breaker (cluster/isolation.py):
+        a surfaced DeviceSegmentError counts one failure toward tripping
+        it open, a healthy guarded call resets the ladder. No-op unless
+        isolation is enabled."""
+        from ..cluster.isolation import ISOLATION
+        if not ISOLATION.enabled:
+            return
+        from ..metrics.profiler import dispatch_context
+        job = dispatch_context()[0]
+        if success:
+            ISOLATION.note_success(job)
+        else:
+            ISOLATION.note_failure(job)
+
     def _sites_ok(self, sites: tuple) -> None:
         for s in sites:
             FAULTS.fire(s)
@@ -461,6 +531,7 @@ class DeviceGuard:
                                        scope=self.scope)
                 if attempt:
                     self._strategy.notify_recovered()
+                self._note_breaker(success=True)
                 return out
             except StallError as e:
                 # a stall is transient first: the abandoned worker never
@@ -471,6 +542,7 @@ class DeviceGuard:
             except InjectedFault as e:
                 if e.poison:
                     self.failures += 1
+                    self._note_breaker(success=False)
                     raise DeviceSegmentError(self.scope, e, poison=True) \
                         from e
                 err, retryable = e, e.transient
@@ -480,6 +552,7 @@ class DeviceGuard:
                 err, retryable = e, True
             if not retryable or attempt >= self.max_retries:
                 self.failures += 1
+                self._note_breaker(success=False)
                 raise DeviceSegmentError(self.scope, err) from err
             attempt += 1
             self.retries += 1

@@ -184,6 +184,11 @@ class StreamTask:
         self._mailbox: queue.Queue = queue.Queue()
         self._cancelled = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # owning-job identity (multi-tenant attribution): every event
+        # this task emits — watchdog trips, fault events, flight dumps,
+        # ledger samples — is tagged with this via the thread-local
+        # dispatch context pinned at thread start (_run_safely)
+        self.job_name = str(self.config.get(PipelineOptions.NAME) or "")
         self.operator_state = OperatorStateBackend()
         self._last_proc_time = 0
         self.io_timers = TaskIOTimers()
@@ -247,6 +252,11 @@ class StreamTask:
 
     def _run_safely(self) -> None:
         from .watchdog import PROGRESS
+        from ..metrics.profiler import set_dispatch_context
+        # pin the owning job for the whole task thread so watchdog/fault/
+        # flight events are job-attributable even with the ledger off;
+        # the operator chain narrows the operator part per dispatch
+        set_dispatch_context(self.job_name, self.task_id)
         self.io_timers.start()
         self.progress.bump()  # deploy->start latency never reads as a stall
         PROGRESS.register(self.task_id, self.progress)
@@ -300,6 +310,9 @@ class SourceStreamTask(StreamTask):
         # watermark-alignment + admission-control observability
         self.alignment_pauses = 0
         self.alignment_max_overshoot_ms = 0
+        # multi-tenant admission gate observability (cluster/isolation.py)
+        self.sched_pauses = 0      # 1ms quota waits at the gate
+        self.sched_sheds = 0       # micro-batches quarantined by overload
         self.current_batch_size = 0
         from collections import deque
         self.batch_size_history: deque = deque(maxlen=1024)
@@ -337,7 +350,100 @@ class SourceStreamTask(StreamTask):
     def trigger_checkpoint(self, barrier: CheckpointBarrier) -> None:
         self.execute_in_mailbox(lambda: self._snapshot(barrier))
 
+    def _admission_gate(self, out: Output) -> str:
+        """Per-job micro-batch admission (cluster/isolation.py).
+
+        Polls ``ISOLATION.try_admit`` before each read. ``"retry"``
+        waits ~1ms per poll with the mailbox live and the wait counted
+        as backpressure (the alignment-pause idiom); a shed verdict
+        reads the batch anyway and quarantines it to the dead-letter
+        side output under a typed ``OverloadShedError`` — counted and
+        flight-recorded against THIS job only, never surfaced as a task
+        failure (shedding is the bulkhead working, not the job dying).
+        Returns ``"admitted"``, ``"shed"`` (caller continues its loop),
+        or ``"stop"`` (cancelled / reader exhausted mid-shed)."""
+        from ..cluster.isolation import ISOLATION, OverloadShedError
+        from ..metrics.tracing import record_flight_event
+        from .faults import FAULTS
+
+        job = self.job_name
+        waited = 0.0
+        ISOLATION.note_waiting(job, +1)
+        try:
+            while True:
+                # chaos sites: a sched.admit trip fails/hangs the gate
+                # itself; a sched.shed trip forces a shed without overload
+                FAULTS.fire("sched.admit")
+                verdict = ("shed:injected" if FAULTS.check("sched.shed")
+                           else ISOLATION.try_admit(job, waited))
+                if verdict == "admit":
+                    if waited > 0.0:
+                        # throttle wait is attributed device-side so the
+                        # ledger's per-job view shows quota pressure
+                        from ..metrics.profiler import DEVICE_LEDGER
+                        DEVICE_LEDGER.record(
+                            "sched.throttle", waited * 1e3, job=job,
+                            operator=self.task_id, kind="dispatch")
+                        if TRACER.enabled:
+                            end = now_ms()
+                            (TRACER.span("sched", "Admit")
+                             .set_attribute("job", job)
+                             .set_attribute("task", self.task_id)
+                             .set_attribute("waited_ms",
+                                            round(waited * 1e3, 3))
+                             .set_start_ts(end - int(waited * 1e3))
+                             .finish(end))
+                    return "admitted"
+                if verdict == "retry":
+                    if self._cancelled.is_set():
+                        return "stop"
+                    self.sched_pauses += 1
+                    time.sleep(0.001)  # gated: mailbox stays live below
+                    waited += 0.001
+                    # quota-paused counts as backpressured, not idle: a
+                    # competing tenant's consumption is what we wait on
+                    self.io_timers.backpressured_s += 0.001
+                    self._drain_mailbox()
+                    self._advance_processing_time(self.chain)
+                    continue
+                # shed:* — quarantine the next batch to dead-letter
+                reason = verdict.partition(":")[2] or "gate-timeout"
+                batch = self.reader.read_batch(self.current_batch_size)
+                if batch is None:
+                    return "stop"
+                if not batch.n:
+                    time.sleep(0.001)  # nothing to shed; no tight spin
+                    self.io_timers.idle_s += 0.001
+                    return "shed"
+                err = OverloadShedError(job, reason, waited)
+                ISOLATION.note_shed(job, batch.n, reason)
+                from ..metrics.device import DEVICE_STATS
+                DEVICE_STATS.note_dead_letter(batch.n)
+                # side-emitted when a dead-letter edge is wired on this
+                # vertex; otherwise the counters + flight event are the
+                # record (device_window._dead_letter semantics)
+                try:
+                    out.emit_side("dead-letter", batch)
+                except NotImplementedError:
+                    pass
+                record_flight_event(
+                    "overload-shed", job=job, task=self.task_id,
+                    reason=reason, records=batch.n, error=repr(err))
+                if TRACER.enabled:
+                    (TRACER.span("sched", "Shed")
+                     .set_attribute("job", job)
+                     .set_attribute("task", self.task_id)
+                     .set_attribute("reason", reason)
+                     .set_attribute("records", batch.n)
+                     .finish())
+                self.sched_sheds += 1
+                self.progress.bump()  # shedding IS progress, not a stall
+                return "shed"
+        finally:
+            ISOLATION.note_waiting(job, -1)
+
     def invoke(self) -> None:
+        from ..cluster.isolation import ISOLATION
         batch_size = self.config.get(PipelineOptions.BATCH_SIZE)
         wm_interval = self.config.get(PipelineOptions.AUTO_WATERMARK_INTERVAL)
         latency_interval = self.config.get(MetricOptions.LATENCY_INTERVAL)
@@ -392,6 +498,15 @@ class SourceStreamTask(StreamTask):
                     # pausing stops READING only — processing-time timers
                     # in the chained operators must keep firing
                     self._advance_processing_time(self.chain)
+                    continue
+            # multi-tenant admission gate (cluster/isolation.py): under
+            # contention this job spends one quota credit per micro-batch;
+            # sustained overload or an open breaker sheds instead
+            if ISOLATION.enabled:
+                verdict = self._admission_gate(out)
+                if verdict == "stop":
+                    break
+                if verdict == "shed":
                     continue
             t0 = time.perf_counter()
             batch = self.reader.read_batch(self.current_batch_size)

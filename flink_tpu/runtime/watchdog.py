@@ -236,12 +236,17 @@ class Watchdog:
 
     def _note_trip(self, site: str, scope: Optional[str],
                    deadline: float) -> None:
+        # the owning job (thread-local dispatch context, pinned at task-
+        # thread start): multi-job stall events/dumps must be attributable
+        # to ONE tenant's failure domain
+        from ..metrics.profiler import dispatch_context
+        job = dispatch_context()[0]
         with self._lock:
             self.trips[site] = self.trips.get(site, 0) + 1
             if len(self.events) < 1024:
                 self.events.append({
                     "timestamp": time.time(), "kind": "watchdog-stall",
-                    "site": site, "scope": scope,
+                    "site": site, "scope": scope, "job": job,
                     "deadline_s": deadline})
         from ..metrics.device import DEVICE_STATS
         DEVICE_STATS.note_watchdog_trip(site)
@@ -252,9 +257,10 @@ class Watchdog:
         (TRACER.span("watchdog", "Stall")
          .set_attribute("site", site)
          .set_attribute("scope", scope)
+         .set_attribute("job", job)
          .set_attribute("deadline_s", deadline)
          .finish())
-        dump_flight_recorder("stall", site=site, scope=scope,
+        dump_flight_recorder("stall", site=site, scope=scope, job=job,
                              deadline_s=deadline)
 
 

@@ -68,7 +68,12 @@ def test_tier_b_clean_on_tiny_q5():
     del jax
     from flink_tpu.metrics.device import PROGRAM_AUDIT
     from flink_tpu.analysis.jaxpr_rules import exercise_programs
-    if not PROGRAM_AUDIT:
+    # an earlier pipeline test may have part-populated the audit (window
+    # programs only); every Tier-B rule needs its scope present or it
+    # skips, so exercise whenever the mesh/chain sentinels are missing
+    scopes = {e.scope for e in PROGRAM_AUDIT}
+    if (not {"chain.fused_prelude", "chain.fused_step"} <= scopes
+            or not any(s.startswith("mesh.") for s in scopes)):
         exercise_programs()
     skipped: list = []
     findings = run_rules(AnalysisContext(), TIER_B, skipped)
@@ -219,6 +224,49 @@ def test_seeded_rogue_ledger_site_detected(tmp_path):
     symbols = {f.symbol for f in findings}
     assert "code-not-inventoried:device_window.step" not in symbols
     assert "inventoried-not-in-code:mesh.step" in symbols
+
+
+def test_sched_inventory_rows_locked(tmp_path):
+    """The isolation scheduler's observability contract is inventoried:
+    its chaos sites (sched.admit / sched.shed) are declared FAULT_SITES
+    members, its spans (sched/Admit, sched/Shed) are in SPAN_INVENTORY,
+    and its ledger site (sched.throttle) is in LEDGER_SITE_INVENTORY — a
+    mini package exercising all of them draws no undeclared/rogue
+    findings, while lookalike rogues at the same scopes still do."""
+    ctx = _mini_pkg(tmp_path, {
+        "gate.py": """\
+            from .wiring import DEVICE_LEDGER, FAULTS, TRACER
+
+            def gate(job, waited):
+                FAULTS.fire("sched.admit")
+                if FAULTS.check("sched.shed"):
+                    TRACER.span("sched", "Shed").finish()
+                    return "shed"
+                DEVICE_LEDGER.record("sched.throttle", waited * 1e3)
+                TRACER.span("sched", "Admit").finish()
+                return "admit"
+
+            def rogue(ms):
+                FAULTS.fire("sched.evict")                # line 13
+                TRACER.span("sched", "Starve").finish()
+                DEVICE_LEDGER.record("sched.rogue", ms)
+            """,
+    })
+    f301 = {f.symbol for f in run_rules(ctx, ["TPU301"])}
+    f302 = {f.symbol for f in run_rules(ctx, ["TPU302"])}
+    f305 = {f.symbol for f in run_rules(ctx, ["TPU305"])}
+    for sym in ("code-not-inventoried:sched.Admit",
+                "code-not-inventoried:sched.Shed"):
+        assert sym not in f301, f"{sym}: SPAN_INVENTORY row went missing"
+    for sym in ("undeclared-site:sched.admit",
+                "undeclared-site:sched.shed"):
+        assert sym not in f302, f"{sym}: FAULT_SITES member went missing"
+    assert "code-not-inventoried:sched.throttle" not in f305, \
+        "sched.throttle: LEDGER_SITE_INVENTORY row went missing"
+    # the lock still bites on undeclared lookalikes
+    assert "code-not-inventoried:sched.Starve" in f301
+    assert "undeclared-site:sched.evict" in f302
+    assert "code-not-inventoried:sched.rogue" in f305
 
 
 def test_seeded_unlocked_mutation_detected(tmp_path):
