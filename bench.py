@@ -239,8 +239,14 @@ def _collect_metrics(env, before: dict) -> dict:
               "watchdog_trips_total", "stall_detections_total",
               "checkpoint_verify_failures_total", "restore_fallbacks_total",
               "network_reconnects_total", "frames_deduped_total",
-              "zombies_fenced_total", "network_errors_total"):
+              "zombies_fenced_total", "network_errors_total",
+              "leader_elections_total", "coordinator_failovers_total",
+              "takeover_duration_ms_count"):
         out[k] = snap.get(k, 0) - before.get(k, 0)
+    # takeover-duration histogram readings (point-in-time; nonzero only
+    # after a standby coordinator took over a running job)
+    for k in ("takeover_duration_ms_p50", "takeover_duration_ms_max"):
+        out[k] = snap.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
         t = getattr(task, "io_timers", None)
@@ -495,7 +501,12 @@ CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
               # plus one forced shed to the dead-letter output — the
               # two-tenant starvation drills are asserted exactly in
               # tests/test_isolation.py
-              "sched.admit=every@7!hang@5,sched.shed=once@4")
+              "sched.admit=every@7!hang@5,sched.shed=once@4,"
+              # coordinator-failover site: a no-op here (only the
+              # distributed leader's monitor loop visits it — a local run
+              # has no elected coordinator); the kill-the-leader drills
+              # are asserted exactly in tests/test_failover.py
+              "coord.crash=once@2")
 
 
 def _run_q7(n_keys: int, n_events: int, capacity: int,
@@ -1462,7 +1473,18 @@ def chaos(seed: int) -> None:
            "net_reconnects": stages.get("network_reconnects_total", 0),
            "frames_deduped": stages.get("frames_deduped_total", 0),
            "zombies_fenced": stages.get("zombies_fenced_total", 0),
-           "net_errors": stages.get("network_errors_total", 0)}
+           "net_errors": stages.get("network_errors_total", 0),
+           # coordinator-failover surface: elections won, takeovers
+           # completed (hot + restore) and the takeover-duration
+           # histogram — all zero here (no elected coordinator in a
+           # local run); nonzero in the distributed failover drills
+           "leader_elections": stages.get("leader_elections_total", 0),
+           "coordinator_failovers": stages.get(
+               "coordinator_failovers_total", 0),
+           "takeover_ms": {
+               "count": stages.get("takeover_duration_ms_count", 0),
+               "p50": stages.get("takeover_duration_ms_p50", 0.0),
+               "max": stages.get("takeover_duration_ms_max", 0.0)}}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in stages.items()})
     print(json.dumps(rec))

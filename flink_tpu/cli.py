@@ -662,6 +662,37 @@ def _cmd_lint(args) -> int:
     return 1 if (new or stale) else 0
 
 
+def _cmd_leader(args) -> int:
+    """Who currently leads the coordinator election over an HA dir
+    (cluster/ha.py leader_info): leader owner, fencing epoch, lease age,
+    published address, standby roster."""
+    import json as _json
+
+    from .cluster.ha import leader_info
+
+    info = leader_info(args.ha_dir)
+    if args.json:
+        print(_json.dumps(info, indent=2, sort_keys=True))
+        return 0 if info.get("leader") else 1
+    leader = info.get("leader")
+    if not leader:
+        print(f"no leader for {args.ha_dir}")
+        if info.get("standbys"):
+            print(f"standbys ({info['standby_count']}): "
+                  + ", ".join(info["standbys"]))
+        return 1
+    age = info.get("lease_age")
+    print(f"leader:   {leader}")
+    print(f"epoch:    {info.get('epoch')}")
+    print(f"lease age: {age:.3f}s" if age is not None else "lease age: ?")
+    if info.get("address"):
+        print(f"address:  {info['address']}")
+    print(f"standbys: {info.get('standby_count', 0)}"
+          + (f" ({', '.join(info['standbys'])})"
+             if info.get("standbys") else ""))
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="flink-tpu", description="flink-tpu command line client")
@@ -822,6 +853,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     plan.add_argument("args", nargs="*",
                       help="argv passed through to the script")
     plan.set_defaults(fn=_cmd_plan)
+
+    ldr = sub.add_parser(
+        "leader", help="print the current coordinator-election leader "
+                       "of an HA dir (owner, fencing epoch, lease age, "
+                       "standby count)")
+    ldr.add_argument("ha_dir", help="the job's ha.dir")
+    ldr.add_argument("--json", action="store_true",
+                     help="machine-readable payload")
+    ldr.set_defaults(fn=_cmd_leader)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=lambda a: (print("flink-tpu 0.1"), 0)[1])

@@ -34,17 +34,20 @@ import socket
 import struct
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ..checkpoint.storage import (
     CheckpointNotFoundError, CompletedCheckpoint, CorruptArtifactError,
     FsCheckpointStorage, MemoryCheckpointStorage,
 )
 from ..core.config import (
-    CheckpointingOptions, Configuration, RuntimeOptions, StateOptions,
+    CheckpointingOptions, Configuration, HaOptions, RuntimeOptions,
+    StateOptions,
 )
 from .failover import restart_strategy_from_config
+from .ha import FileHaServices, LeaderElectionService, read_leader_record
 from .resource_manager import SlotManager, build_schedule
 from ..graph.stream_graph import JobGraph
 from ..runtime.channels import InputGate, LocalChannel
@@ -57,7 +60,8 @@ from ..runtime.writer import RecordWriter
 from .local import LocalJob, _make_reader, _side_outputs_map
 from .transport import RemoteChannelSender, TransportServer
 
-__all__ = ["DistributedHost", "run_distributed", "subtask_host"]
+__all__ = ["CoordinatorContender", "DistributedHost", "run_distributed",
+           "subtask_host"]
 
 _MSG = struct.Struct("<I")
 
@@ -118,9 +122,27 @@ class _Coordinator:
     completion (reference JobMaster + CheckpointCoordinator + heartbeat
     services, collapsed onto one control socket per worker)."""
 
-    def __init__(self, n_hosts: int, config: Configuration, port: int = 0):
+    def __init__(self, n_hosts: int, config: Configuration, port: int = 0,
+                 ha: Optional[FileHaServices] = None, token: int = -1,
+                 job_id: str = "job", owner: str = "coord"):
         self.n_hosts = n_hosts
         self.config = config
+        # coordinator failover (docs/ROBUSTNESS.md, 'Coordinator
+        # failover'): with an HA service attached, every trigger,
+        # completion, and restart is journaled under this leader's
+        # fencing ``token``; a REFUSED write means a successor holds a
+        # higher token — this coordinator is a zombie and deposes itself
+        # instead of committing anything the successor will replay
+        self.ha = ha
+        self.token = token
+        self.job_id = job_id
+        self.owner = owner
+        self._closed = False
+        self._deposed = threading.Event()
+        self._takeover: Optional[dict] = None
+        self._worker_addrs: dict[int, Any] = {}
+        self.on_deposed: Optional[Callable[[], None]] = None
+        self.on_crash: Optional[Callable[[], None]] = None
         directory = config.get(CheckpointingOptions.DIRECTORY)
         self.storage = (FsCheckpointStorage(directory, config=config)
                         if directory else MemoryCheckpointStorage())
@@ -168,8 +190,9 @@ class _Coordinator:
         # failure reports and restart decisions, oldest evicted first
         from collections import deque
         self.failure_history: deque = deque(maxlen=64)
-        threading.Thread(target=self._accept_loop, name="coord-accept",
-                         daemon=True).start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="coord-accept", daemon=True)
+        self._accept_thread.start()
 
     def set_topology(self, jg: JobGraph) -> None:
         self._vertex_parallelism = {vid: v.parallelism
@@ -240,6 +263,9 @@ class _Coordinator:
                         self._all_done_sent = False
                     self.resources.register_worker(host_id,
                                                    msg.get("slots", 1))
+                    if msg.get("data_addr") is not None:
+                        with self._lock:
+                            self._worker_addrs[host_id] = msg["data_addr"]
                 elif kind == "heartbeat":
                     with self._lock:
                         w = self._workers.get(msg["host_id"])
@@ -331,6 +357,19 @@ class _Coordinator:
                         .set_attribute("savepoint", is_savepoint)
                         .set_attribute("hosts", len(self._pending_hosts[cid])))
                 self._pending_spans[cid] = span
+        if self.ha is not None and not self._journal_ha("trigger"):
+            # fenced: a successor leads. Roll the trigger back — the
+            # journaled next_cid the successor adopted already covers this
+            # cid, so its checkpoint directories can never collide with a
+            # zombie's in-flight ones
+            with self._lock:
+                self._pending_acks.pop(cid, None)
+                self._pending_hosts.pop(cid, None)
+                orphan = self._pending_spans.pop(cid, None)
+            if orphan is not None:
+                orphan.set_attribute("aborted", True) \
+                      .set_attribute("fenced", True).finish()
+            return -1
         self.broadcast({"type": "trigger_checkpoint", "checkpoint_id": cid,
                         "savepoint": is_savepoint,
                         "trace": span.context.to_wire() if span else None})
@@ -385,6 +424,19 @@ class _Coordinator:
                     vertex_parallelism=dict(self._vertex_parallelism),
                     vertex_uids=dict(self._vertex_uids))
                 del self._pending_hosts[cid]
+        if complete is not None and self.ha is not None:
+            # cheap fence check BEFORE the (possibly large) store: a
+            # deposed leader must not even write the artifact, let alone
+            # complete the checkpoint
+            lease = self.ha._lease_token()
+            if lease is not None and lease > self.token:
+                with self._lock:
+                    orphan = self._pending_spans.pop(cid, None)
+                if orphan is not None:
+                    orphan.set_attribute("aborted", True) \
+                          .set_attribute("fenced", True).finish()
+                self._depose(f"deposed before storing checkpoint {cid}")
+                return
         if complete is not None:
             from ..metrics.tracing import TRACER
             with self._lock:
@@ -425,6 +477,27 @@ class _Coordinator:
                         root_sb.set_attribute("aborted", True).finish()
                     return
                 self.completed.append(complete)
+            if self.ha is not None:
+                # fenced commit point: the checkpoint pointer and journal
+                # must land under OUR token before any worker is told to
+                # commit — a refusal means a successor exists, and its
+                # restore would replay the sink output this notification
+                # would have committed
+                ok = self.ha.put_checkpoint(
+                    self.job_id, self.token,
+                    {"checkpoint_id": cid,
+                     "external_path": complete.external_path,
+                     "timestamp": complete.timestamp})
+                ok = ok and self._journal_ha(f"checkpoint-{cid}-complete")
+                if not ok:
+                    with self._lock:
+                        if complete in self.completed:
+                            self.completed.remove(complete)
+                    if root_sb is not None:
+                        root_sb.set_attribute("aborted", True) \
+                               .set_attribute("fenced", True).finish()
+                    self._depose(f"checkpoint {cid} completion fenced")
+                    return
             # stamped with the epoch CAPTURED at ack time (not re-read:
             # a concurrent bump would stamp the new epoch and defeat the
             # workers' gate) so a worker that restarted between the ack
@@ -440,6 +513,166 @@ class _Coordinator:
                  .set_attribute("hosts", self.n_hosts)
                  .finish())
                 root_sb.finish()
+
+    # -- coordinator failover (HA) ----------------------------------------
+    def _journal_locked(self) -> dict:
+        """Everything a successor needs to take over the RUNNING job
+        (caller holds ``self._lock``): attempt epoch, the next checkpoint
+        id, expected hosts + slots, worker data addresses, and the last
+        few completed-checkpoint pointers (metadata only — the artifacts
+        live in shared checkpoint storage)."""
+        live = sorted(self._workers)
+        return {
+            "epoch": self.epoch,
+            "next_cid": self._next_cid,
+            "restarts": self.restarts,
+            "expected": sorted(self._expected),
+            "slots": self.resources.slots_map(live),
+            "worker_addrs": dict(self._worker_addrs),
+            "completed": [
+                {"checkpoint_id": c.checkpoint_id,
+                 "external_path": c.external_path,
+                 "is_savepoint": c.is_savepoint}
+                for c in self.completed[-8:] if c.external_path],
+        }
+
+    def _journal_ha(self, event: str) -> bool:
+        """Journal takeover state into the HA store under this leader's
+        fencing token. Returns False — after deposing this coordinator —
+        when the write was refused (a successor holds a higher token)."""
+        if self.ha is None:
+            return True
+        with self._lock:
+            journal = self._journal_locked()
+        if self.ha.put_journal(self.job_id, self.token, journal):
+            return True
+        self._depose(f"journal write fenced at {event}")
+        return False
+
+    def _depose(self, reason: str) -> None:
+        """A fenced HA write revealed a successor: this coordinator is a
+        zombie. Stop leading NOW — close the server and every worker
+        control socket so the workers re-resolve the leader record and
+        re-register with the successor. The job is NOT failed: it keeps
+        running under the new leader."""
+        if self._deposed.is_set():
+            return
+        self._deposed.set()
+        from ..metrics.device import DEVICE_STATS
+        DEVICE_STATS.note_zombie_fenced("coordinator-deposed")
+        with self._lock:
+            self.failure_history.append({
+                "timestamp": time.time(), "kind": "leader-deposed",
+                "token": self.token, "reason": reason})
+        if self.on_deposed is not None:
+            try:
+                self.on_deposed()
+            except Exception:  # noqa: BLE001 - best-effort notification
+                pass
+        self.close()
+
+    def crash(self) -> None:
+        """Simulated leader kill (site coord.crash / test hook): drop the
+        server and every worker control socket with no cleanup and no HA
+        release — exactly what SIGKILL leaves behind. ``on_crash`` lets
+        the owning contender stop renewing its lease so a standby must
+        steal it the hard way."""
+        if self.on_crash is not None:
+            try:
+                self.on_crash()
+            except Exception:  # noqa: BLE001 - crash must not half-fail
+                pass
+        self.close()
+
+    def adopt_journal(self, journal: dict) -> None:
+        """Resume a predecessor's job state after winning the election:
+        attempt epoch (hot takeover keeps it — the data-plane edge keys
+        and transport fencing are epoch-derived, so bumping it would kill
+        live channels; the LEASE token is the fencing epoch that bumped),
+        the checkpoint-id counter (so this leader's chk-N directories
+        never collide with a zombie's in-flight ones), expected hosts,
+        and the retained completed-checkpoint pointers."""
+        with self._lock:
+            self.epoch = int(journal.get("epoch", self.epoch))
+            self._next_cid = max(self._next_cid,
+                                 int(journal.get("next_cid", 1)))
+            self.restarts = int(journal.get("restarts", 0))
+            expected = journal.get("expected")
+            if expected:
+                self._expected = {int(h) for h in expected}
+            self._worker_addrs = dict(journal.get("worker_addrs") or {})
+        if isinstance(self.storage, FsCheckpointStorage):
+            adopted = []
+            for rec in journal.get("completed", []):
+                path = rec.get("external_path")
+                if not path:
+                    continue
+                try:
+                    cp = self.storage.load(path, resolve=False)
+                except (OSError, CheckpointNotFoundError,
+                        CorruptArtifactError):
+                    continue  # verified-candidate walk handles the rest
+                adopted.append(cp)
+            with self._lock:
+                known = {c.checkpoint_id for c in self.completed}
+                for cp in adopted:
+                    if cp.checkpoint_id not in known:
+                        self.completed.append(cp)
+                self.completed.sort(key=lambda c: c.checkpoint_id)
+
+    def arm_takeover(self, expected: set[int], t0: float,
+                     span: Any = None) -> None:
+        """Start the takeover clock: ``monitor`` resolves it HOT the
+        moment every expected worker has re-registered, or falls back to
+        a fenced restore when ``ha.takeover-timeout`` expires first."""
+        deadline = t0 + float(self.config.get(HaOptions.TAKEOVER_TIMEOUT))
+        with self._lock:
+            self._takeover = {"expected": set(expected), "t0": t0,
+                              "deadline": deadline, "span": span}
+
+    def _resolve_takeover(self) -> None:
+        with self._lock:
+            tk = self._takeover
+            if tk is None:
+                return
+            missing = tk["expected"] - set(self._workers)
+            if missing and time.time() < tk["deadline"]:
+                return
+            self._takeover = None
+        from ..metrics.device import DEVICE_STATS
+        from ..metrics.tracing import dump_flight_recorder
+        took_ms = (time.time() - tk["t0"]) * 1000.0
+        mode = "hot" if not missing else "restore"
+        DEVICE_STATS.note_coordinator_failover(took_ms, mode)
+        span = tk.get("span")
+        if span is not None:
+            (span.set_attribute("mode", mode)
+                 .set_attribute("missing", sorted(missing))
+                 .set_attribute("took_ms", round(took_ms, 1))
+                 .finish())
+        dump_flight_recorder("failover", mode=mode, token=self.token,
+                             epoch=self.epoch, took_ms=round(took_ms, 1),
+                             missing=sorted(missing))
+        with self._lock:
+            self.failure_history.append({
+                "timestamp": time.time(), "kind": "takeover", "mode": mode,
+                "token": self.token, "took_ms": round(took_ms, 1),
+                "missing": sorted(missing)})
+        if missing:
+            # workers died alongside the old leader: declare them dead
+            # and fall back to a fenced global restore from the latest
+            # verified checkpoint — exactly-once either way
+            from ..runtime.watchdog import WATCHDOG
+            WATCHDOG.note_stall(
+                "ha.takeover",
+                float(self.config.get(HaOptions.TAKEOVER_TIMEOUT)),
+                scope="coordinator")
+            reason = (f"takeover: worker(s) {sorted(missing)} did not "
+                      "re-register within ha.takeover-timeout")
+            if not self._maybe_restart(sorted(missing), reason):
+                with self._lock:
+                    self.failed = reason
+                self.broadcast({"type": "cancel"})
 
     # -- failover ----------------------------------------------------------
     def _verified_candidate_locked(self):
@@ -552,6 +785,11 @@ class _Coordinator:
                 w.finished = False
             cp = self._verified_candidate_locked()
             self._restart_inflight = False
+        if self.ha is not None and not self._journal_ha("restart"):
+            # deposed: the successor owns the restart decision
+            for sp in orphan_spans:
+                sp.set_attribute("aborted", True).finish()
+            return
         from ..metrics.tracing import TRACER, dump_flight_recorder
         for sp in orphan_spans:
             sp.set_attribute("aborted", True).finish()
@@ -593,9 +831,17 @@ class _Coordinator:
         when restarts are disabled/exhausted. Also announces global
         completion (all_done) so workers that finished early stay
         available for failover until the whole job is done."""
+        from ..runtime.faults import FAULTS
         self._hb_timeout = heartbeat_timeout
         while not self._stop.is_set():
             time.sleep(heartbeat_timeout / 3)
+            if FAULTS.enabled and FAULTS.check("coord.crash"):
+                # chaos drill: the leader dies mid-flight — every socket
+                # drops and (via on_crash) its lease stops renewing, so a
+                # standby steals leadership and takes the job over
+                self.crash()
+                return
+            self._resolve_takeover()
             now = time.time()
             # cross-host watermark alignment: combine live workers' group
             # minima, broadcast the global view (reference SourceCoordinator
@@ -641,11 +887,209 @@ class _Coordinator:
                     and all(w.finished for w in self._workers.values()))
 
     def close(self) -> None:
+        """Idempotent teardown: safe from the contender's revoke path,
+        the depose path, crash(), and host shutdown all at once. Closes
+        the listening socket (releasing the port immediately — a standby
+        promoted on the same host must never hit EADDRINUSE) AND every
+        worker control socket, so connected workers notice leadership
+        loss at once instead of waiting out a heartbeat window."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            workers = list(self._workers.values())
         self._stop.set()
+        # shutdown() wakes the thread blocked in accept(); without it the
+        # blocked syscall keeps a kernel reference to the socket and the
+        # port stays bound past close() — the EADDRINUSE a promoted
+        # standby on the same host would hit
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
+        if threading.current_thread() is not self._accept_thread:
+            self._accept_thread.join(timeout=1.0)
+        for w in workers:
+            try:
+                w.sock.close()
+            except OSError:
+                pass
+
+
+class CoordinatorContender:
+    """A would-be coordinator master: contends for leadership over the
+    job's HA dir and, when granted, promotes a fresh ``_Coordinator`` on
+    its own port, publishes the fenced leader record so workers can find
+    it, adopts the predecessor's journal, and resolves the takeover —
+    HOT when every journaled worker re-registers within
+    ``ha.takeover-timeout`` (no restart, checkpointing simply resumes),
+    fenced restore from the latest verified checkpoint otherwise. Run
+    one per would-be master process (the reference's Dispatcher /
+    JobMaster leader contender, SURVEY §2.3, collapsed onto the file
+    lease). SPMD applies to masters too: every contender builds the
+    identical JobGraph locally, so no topology ships through the HA
+    store beyond the journal's numbers."""
+
+    def __init__(self, jg: JobGraph, config: Configuration, ha_dir: str,
+                 n_hosts: int, owner: Optional[str] = None,
+                 job_id: Optional[str] = None, coordinator_port: int = 0):
+        self.jg = jg
+        self.config = config
+        self.n_hosts = n_hosts
+        self.owner = owner or f"coord-{uuid.uuid4().hex[:6]}"
+        self.job_id = job_id or getattr(jg, "name", None) or "job"
+        self.ha = FileHaServices(ha_dir)
+        self._port = coordinator_port
+        self.coordinator: Optional[_Coordinator] = None
+        self._coord_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._started = False
+        self._killed = False
+        self._lease_timeout = float(config.get(HaOptions.LEASE_TIMEOUT))
+        self.election = LeaderElectionService(
+            ha_dir, self.owner, self._lease_timeout,
+            on_grant=self._on_grant, on_revoke=self._on_revoke)
+
+    def start(self) -> "CoordinatorContender":
+        if self._started:
+            return self
+        self._started = True
+        self.ha.announce_standby(self.owner)
+        threading.Thread(target=self._presence_loop,
+                         name=f"standby-{self.owner}", daemon=True).start()
+        self.election.start()
+        return self
+
+    def _presence_loop(self) -> None:
+        while not self._stop.is_set():
+            self.ha.announce_standby(self.owner)
+            self._stop.wait(max(self._lease_timeout, 0.5))
+
+    def _on_grant(self, token: int) -> None:
+        from ..metrics.device import DEVICE_STATS
+        from ..metrics.tracing import TRACER
+        DEVICE_STATS.note_leader_election("coordinator")
+        t0 = time.time()
+        journal = self.ha.get_journal(self.job_id)
+        coord = _Coordinator(self.n_hosts, self.config, port=self._port,
+                             ha=self.ha, token=token, job_id=self.job_id,
+                             owner=self.owner)
+        coord.set_topology(self.jg)
+        coord.on_deposed = self.election.step_down
+        coord.on_crash = self.kill  # coord.crash = full master death
+        if journal:
+            coord.adopt_journal(journal)
+        addr = f"127.0.0.1:{coord.port}"
+        if not self.ha.publish_leader_record(token, addr, self.owner):
+            # a successor was elected past us (we stalled between the
+            # grant and here): never lead on a stale token
+            coord.close()
+            self.election.step_down()
+            return
+        span = None
+        if journal and TRACER.enabled:
+            span = (TRACER.span("ha", "Takeover")
+                    .set_attribute("owner", self.owner)
+                    .set_attribute("token", token)
+                    .set_attribute("epoch", coord.epoch))
+        if journal:
+            # a predecessor ran this job: resolve hot-vs-restore against
+            # ITS expected-host set
+            coord.arm_takeover(set(coord._expected), t0, span=span)
+        # first journal write under the new token claims the job — and
+        # proves the fence: any older leader's next write now loses
+        if not coord._journal_ha("takeover-grant"):
+            self.election.step_down()
+            return
+        with self._coord_lock:
+            self.coordinator = coord
+        hb_timeout = (
+            3 * self.config.get(RuntimeOptions.HEARTBEAT_INTERVAL) + 2.0)
+        threading.Thread(target=coord.monitor, args=(hb_timeout,),
+                         name=f"coord-monitor-{self.owner}",
+                         daemon=True).start()
+        interval = self.config.get(CheckpointingOptions.INTERVAL)
+        if interval and interval > 0:
+            def periodic():
+                while not (self._stop.is_set() or coord._stop.is_set()):
+                    time.sleep(interval)
+                    if coord.all_finished() or coord._stop.is_set():
+                        return
+                    coord.trigger_checkpoint()
+            threading.Thread(target=periodic,
+                             name=f"coord-periodic-{self.owner}",
+                             daemon=True).start()
+
+    def _on_revoke(self) -> None:
+        with self._coord_lock:
+            coord, self.coordinator = self.coordinator, None
+        if coord is not None:
+            coord.close()
+
+    def kill(self) -> None:
+        """Simulated master death (tests / site coord.crash): drop every
+        socket and stop renewing the lease WITHOUT releasing it — the
+        standbys must steal it the hard way, exactly as after SIGKILL."""
+        self._killed = True
+        self._stop.set()
+        self.election.stop(release=False)
+        with self._coord_lock:
+            coord, self.coordinator = self.coordinator, None
+        if coord is not None:
+            coord.close()
+
+    def stop(self) -> None:
+        """Graceful shutdown: releases the lease so a standby is granted
+        immediately instead of after the full lease timeout."""
+        self._stop.set()
+        self.election.stop(release=True)
+        self.ha.withdraw_standby(self.owner)
+        with self._coord_lock:
+            coord, self.coordinator = self.coordinator, None
+        if coord is not None:
+            coord.close()
+
+    def run(self, timeout: float = 120.0) -> dict:
+        """Contend and block until the job completes — under this master
+        or any successor. Returns the published result record."""
+        self.start()
+        deadline = time.time() + timeout
+        try:
+            while time.time() < deadline and not self._stop.is_set():
+                done = self.ha.get_result(self.job_id)
+                if done is not None:
+                    return done
+                with self._coord_lock:
+                    coord = self.coordinator
+                if coord is not None:
+                    if coord.failed is not None:
+                        raise RuntimeError(coord.failed)
+                    if coord.all_finished():
+                        # let the monitor's all_done broadcast land so
+                        # finished workers exit their stay-available loop
+                        settle = time.time() + 2.0
+                        while (not coord._all_done_sent
+                               and time.time() < settle):
+                            time.sleep(0.05)
+                        result = {"status": "done", "owner": self.owner,
+                                  "epoch": coord.epoch,
+                                  "restarts": coord.restarts,
+                                  "checkpoints": len(coord.completed)}
+                        self.ha.put_result(self.job_id, coord.token,
+                                           result)
+                        return result
+                time.sleep(0.05)
+            if self._killed:
+                raise RuntimeError(f"master {self.owner} was killed")
+            raise TimeoutError(
+                f"job {self.job_id} not done within {timeout}s")
+        finally:
+            if not self._killed:
+                self.stop()
 
 
 class DistributedHost:
@@ -654,18 +1098,29 @@ class DistributedHost:
 
     def __init__(self, jg: JobGraph, config: Configuration, host_id: int,
                  n_hosts: int, coordinator_addr: Optional[str] = None,
-                 data_port: int = 0, coordinator_port: int = 0):
+                 data_port: int = 0, coordinator_port: int = 0,
+                 ha_dir: Optional[str] = None):
         self.jg = jg
         self.config = config
         self.host_id = host_id
         self.n_hosts = n_hosts
         self.transport = TransportServer(port=data_port)
+        # coordinator failover: with an HA dir (arg or ha.dir), NO host
+        # embeds a coordinator — masters are separate CoordinatorContender
+        # processes, and this worker resolves whoever currently leads
+        # through the fenced leader record instead of a fixed address
+        self._ha_dir = ha_dir or (config.get(HaOptions.DIR) or None)
         self.coordinator: Optional[_Coordinator] = None
-        if host_id == 0:
+        if host_id == 0 and self._ha_dir is None:
             self.coordinator = _Coordinator(n_hosts, config,
                                             port=coordinator_port)
             self.coordinator.set_topology(jg)
         self._coord_addr = coordinator_addr
+        self._closed = False
+        # set once this host announced "finished" for the current attempt:
+        # after a control reconnect (e.g. a leader takeover) the new
+        # coordinator must re-learn completion or all_done never fires
+        self._announced_finished = threading.Event()
         self._ctrl: Optional[socket.socket] = None
         self.job: Optional[LocalJob] = None
         self._cancelled = threading.Event()
@@ -949,11 +1404,35 @@ class DistributedHost:
         hb = 3 * cfg.get(RuntimeOptions.HEARTBEAT_INTERVAL) + 2.0
         return max(backoff, hb) + 10.0
 
+    def _takeover_timeout(self) -> float:
+        return (float(self.config.get(HaOptions.TAKEOVER_TIMEOUT))
+                if self._ha_dir else 0.0)
+
+    def _resolve_coord_addr(self) -> Optional[str]:
+        """The coordinator's CURRENT address: re-read from the fenced
+        leader record when an HA dir is configured (a takeover moves the
+        coordinator to a fresh port), the fixed construction-time address
+        otherwise."""
+        if self._ha_dir:
+            rec = read_leader_record(self._ha_dir)
+            if rec is not None:
+                self._coord_addr = rec["address"]
+        return self._coord_addr
+
+    def _register_msg(self) -> dict:
+        return {"type": "register", "host_id": self.host_id,
+                "epoch": self._epoch, "uids": self._uid_map(),
+                "slots": self._my_slots(),
+                "data_addr": tuple(self.data_address)}
+
     def _connect_control(self) -> None:
-        host, port = self._coord_addr.split(":")
-        deadline = time.time() + 30
+        deadline = time.time() + max(30.0, self._takeover_timeout())
         while True:
+            addr = self._resolve_coord_addr()
             try:
+                if addr is None:
+                    raise OSError("no leader record published yet")
+                host, port = addr.split(":")
                 self._ctrl = socket.create_connection((host, int(port)),
                                                       timeout=5.0)
                 break
@@ -961,9 +1440,7 @@ class DistributedHost:
                 if time.time() >= deadline:
                     raise
                 time.sleep(0.1)
-        self._ctrl_send({"type": "register", "host_id": self.host_id,
-                         "epoch": self._epoch, "uids": self._uid_map(),
-                         "slots": self._my_slots()})
+        self._ctrl_send(self._register_msg())
         threading.Thread(target=self._control_loop, name="worker-control",
                          daemon=True).start()
         threading.Thread(target=self._heartbeat_loop,
@@ -988,10 +1465,21 @@ class DistributedHost:
             timeout = float(self.config.get(NetworkOptions.RECONNECT_TIMEOUT))
             if timeout <= 0:
                 return False
-            host, port = self._coord_addr.split(":")
+            if self._ha_dir:
+                # a leader election may be in progress: the deadline must
+                # outlive the lease-steal + promotion gap, so the worker
+                # is still dialing when the successor publishes its record
+                timeout = max(timeout, self._takeover_timeout())
             net_deadline = time.monotonic() + timeout
             while True:
+                # re-resolve EVERY attempt: after a takeover the old
+                # address is permanently dead — redialing it forever would
+                # turn a survivable failover into a lost worker
+                addr = self._resolve_coord_addr()
                 try:
+                    if addr is None:
+                        raise OSError("no leader record published yet")
+                    host, port = addr.split(":")
                     sock = socket.create_connection((host, int(port)),
                                                     timeout=5.0)
                     break
@@ -1009,11 +1497,14 @@ class DistributedHost:
             except OSError:
                 pass
             try:
-                self._ctrl_send({"type": "register",
-                                 "host_id": self.host_id,
-                                 "epoch": self._epoch,
-                                 "uids": self._uid_map(),
-                                 "slots": self._my_slots()})
+                self._ctrl_send(self._register_msg())
+                if (self._announced_finished.is_set()
+                        and not self._redeploying.is_set()):
+                    # the previous leader knew this host finished; the
+                    # new one must too, or all_done never fires
+                    self._ctrl_send({"type": "finished",
+                                     "host_id": self.host_id,
+                                     "epoch": self._epoch})
             except (OSError, StallError):
                 return False
             from ..metrics.device import DEVICE_STATS
@@ -1293,7 +1784,7 @@ class DistributedHost:
             # host 0 participates as a worker too, over loopback — its task
             # acks flow through the same control path as everyone else's
             self._coord_addr = f"127.0.0.1:{self.coordinator.port}"
-        if self._coord_addr is not None:
+        if self._coord_addr is not None or self._ha_dir:
             self._connect_control()
         if self.coordinator is not None:
             hb_timeout = 3 * self.config.get(
@@ -1328,6 +1819,7 @@ class DistributedHost:
                     intent = self._restart_intent
                     self._restart_intent = None
                 if intent is not None:
+                    self._announced_finished.clear()
                     if job is not None:
                         for t in job.tasks.values():
                             t.cancel()
@@ -1377,11 +1869,7 @@ class DistributedHost:
                 self._redeploying.clear()
                 if epoch > 0 and self._ctrl is not None:
                     # announce readiness for the new attempt
-                    self._ctrl_send({"type": "register",
-                                     "host_id": self.host_id,
-                                     "epoch": self._epoch,
-                                     "uids": self._uid_map(),
-                                     "slots": self._my_slots()})
+                    self._ctrl_send(self._register_msg())
                 job.start()
                 try:
                     job.wait(remaining())
@@ -1412,6 +1900,7 @@ class DistributedHost:
                     continue
                 # finished this attempt normally
                 if self._ctrl is not None:
+                    self._announced_finished.set()
                     try:
                         self._ctrl_send({"type": "finished",
                                          "host_id": self.host_id,
@@ -1435,6 +1924,9 @@ class DistributedHost:
         return job
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._cancelled.set()
         self.transport.close()
         if self.coordinator is not None:
@@ -1450,12 +1942,13 @@ def run_distributed(jg: JobGraph, config: Configuration, host_id: int,
                     n_hosts: int, coordinator_addr: Optional[str],
                     peer_data_addrs: dict[int, tuple[str, int]],
                     data_port: int = 0,
-                    timeout: Optional[float] = 300.0) -> LocalJob:
+                    timeout: Optional[float] = 300.0,
+                    ha_dir: Optional[str] = None) -> LocalJob:
     """Convenience wrapper: construct, run, close. Address discovery (who
     listens where) is the caller's rendezvous concern — tests use a shared
     file, production would use the cluster manager's pod DNS."""
     host = DistributedHost(jg, config, host_id, n_hosts, coordinator_addr,
-                           data_port)
+                           data_port, ha_dir=ha_dir)
     try:
         return host.run(peer_data_addrs, timeout)
     finally:

@@ -38,7 +38,8 @@ try:  # job graphs carry closure-based operator factories: cloudpickle
 except ImportError:  # pragma: no cover - cloudpickle ships in the image
     _graph_pickle = pickle
 
-__all__ = ["LeaderElectionService", "FileHaServices", "HaJobSupervisor"]
+__all__ = ["LeaderElectionService", "FileHaServices", "HaJobSupervisor",
+           "read_leader_record", "leader_info"]
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -98,10 +99,22 @@ class _Lease:
         except (OSError, ValueError):
             return None
 
+    @staticmethod
+    def _lease_fault() -> bool:
+        """Visit the ``ha.lease`` fault site: a trip fails this renew or
+        steal attempt (a ``!hang@MS`` trip sleeps instead — the GC-pause
+        analog that lets the lease expire under a live leader)."""
+        from ..runtime.faults import FAULTS
+        if not FAULTS.enabled:
+            return False
+        return FAULTS.check("ha.lease")
+
     def try_acquire(self) -> bool:
         """Acquire or steal; the whole check-steal-grant sequence runs under
         the flock so a stale leader's concurrent renew cannot interleave
         with a steal (every owner-file mutation shares the lock)."""
+        if self._lease_fault():
+            return False
         with _flocked(self.flock_file):
             try:
                 os.mkdir(self.dir)
@@ -152,6 +165,8 @@ class _Lease:
         Read-verify-write runs under the flock, so a renew can never land
         inside a successor's freshly stolen lease; a missing owner file
         means we were renamed away — treated as loss, never re-written."""
+        if self._lease_fault():
+            return False
         with _flocked(self.flock_file):
             holder = self._read_owner()
             if holder is None or holder["token"] != self.token:
@@ -258,8 +273,91 @@ class FileHaServices:
 
     def __init__(self, ha_dir: str):
         self.dir = ha_dir
-        for sub in ("jobs", "checkpoints", "results"):
+        for sub in ("jobs", "checkpoints", "results", "journal", "standbys"):
             os.makedirs(os.path.join(ha_dir, sub), exist_ok=True)
+
+    # -- leader record (fenced) --------------------------------------------
+    # The address half of leadership: the lease says WHO leads, the record
+    # says WHERE to dial. Workers resolve the coordinator through this
+    # instead of a fixed address, so a standby promoted on a new port is
+    # reachable the moment it publishes.
+    def publish_leader_record(self, token: int, address: str,
+                              owner: str) -> bool:
+        """Publish ``address`` as the coordinator endpoint for fencing
+        ``token``. Fenced like every HA write: refused when a higher token
+        already published or a successor holds the lease."""
+        path = os.path.join(self.dir, "leader.record")
+        with _flocked(path + ".lock"):
+            lease = self._lease_token()
+            if lease is not None and lease > token:
+                return False
+            existing = read_leader_record(self.dir)
+            if existing is not None and existing["token"] > token:
+                return False
+            _atomic_write(path, json.dumps(
+                {"token": token, "address": address, "owner": owner,
+                 "ts": time.time()}).encode())
+            return True
+
+    def get_leader_record(self) -> Optional[dict]:
+        return read_leader_record(self.dir)
+
+    # -- coordinator journal (fenced) --------------------------------------
+    # Everything a successor needs to take over a RUNNING job: topology id,
+    # attempt epoch, next checkpoint id, expected hosts + slots, worker
+    # address map, and the last few completed-checkpoint pointers.
+    def put_journal(self, job_id: str, token: int, journal: dict) -> bool:
+        path = os.path.join(self.dir, "journal", f"{job_id}.pkl")
+        with _flocked(path + ".lock"):
+            lease = self._lease_token()
+            if lease is not None and lease > token:
+                return False
+            existing = self._read(path)
+            if existing is not None and existing["token"] > token:
+                return False
+            _atomic_write(path, pickle.dumps(
+                {"token": token, "journal": journal},
+                pickle.HIGHEST_PROTOCOL))
+            return True
+
+    def get_journal(self, job_id: str) -> Optional[dict]:
+        rec = self._read(os.path.join(self.dir, "journal", f"{job_id}.pkl"))
+        return rec["journal"] if rec else None
+
+    # -- standby presence --------------------------------------------------
+    def announce_standby(self, owner: str) -> None:
+        """Heartbeat this contender's presence for the leader surface
+        (``cli leader`` / REST); purely informational, never fenced."""
+        try:
+            _atomic_write(os.path.join(self.dir, "standbys", f"{owner}.json"),
+                          json.dumps({"owner": owner,
+                                      "ts": time.time()}).encode())
+        except OSError:
+            pass
+
+    def withdraw_standby(self, owner: str) -> None:
+        try:
+            os.unlink(os.path.join(self.dir, "standbys", f"{owner}.json"))
+        except OSError:
+            pass
+
+    def list_standbys(self, ttl: float = 10.0) -> list[str]:
+        out = []
+        root = os.path.join(self.dir, "standbys")
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return out
+        now = time.time()
+        for name in names:
+            try:
+                with open(os.path.join(root, name)) as f:
+                    rec = json.loads(f.read())
+                if now - rec["ts"] < ttl:
+                    out.append(rec["owner"])
+            except (OSError, ValueError, KeyError):
+                continue
+        return sorted(out)
 
     # -- job graphs --------------------------------------------------------
     def put_job_graph(self, job_id: str, job_graph: Any) -> None:
@@ -351,6 +449,54 @@ class FileHaServices:
             # recovery path falls back to scanning the retained checkpoint
             # directories on disk (HaJobSupervisor._verified_restore)
             return None
+
+
+def read_leader_record(ha_dir: str) -> Optional[dict]:
+    """The published leader record ({token, address, owner, ts}) or None.
+    Pure read — safe from any process (workers resolving the coordinator,
+    the CLI, REST) without constructing ``FileHaServices``."""
+    try:
+        with open(os.path.join(ha_dir, "leader.record")) as f:
+            rec = json.loads(f.read())
+        if not isinstance(rec, dict) or "address" not in rec:
+            return None
+        return rec
+    except (OSError, ValueError):
+        return None
+
+
+def leader_info(ha_dir: str, standby_ttl: float = 10.0) -> dict:
+    """One-shot snapshot of the leadership surface for ``cli leader`` and
+    REST ``GET /jobs/<name>/leader``: the current lease holder, fencing
+    epoch, lease age, published coordinator address, and live standbys."""
+    info: dict[str, Any] = {"ha_dir": ha_dir, "leader": None, "epoch": -1,
+                            "lease_age": None, "address": None,
+                            "standbys": [], "standby_count": 0}
+    try:
+        with open(os.path.join(ha_dir, "leader.lock", "owner")) as f:
+            holder = json.loads(f.read())
+        info["leader"] = holder.get("owner")
+        info["epoch"] = holder.get("token", -1)
+        ts = holder.get("ts")
+        if ts is not None:
+            info["lease_age"] = max(0.0, time.time() - ts)
+    except (OSError, ValueError):
+        pass
+    rec = read_leader_record(ha_dir)
+    if rec is not None:
+        info["address"] = rec["address"]
+        if info["leader"] is None:
+            # lease gone (leader died, not yet stolen): the record still
+            # names the last known coordinator and its epoch
+            info["leader"] = rec.get("owner")
+            info["epoch"] = rec.get("token", info["epoch"])
+    try:
+        standbys = FileHaServices(ha_dir).list_standbys(ttl=standby_ttl)
+    except OSError:
+        standbys = []
+    info["standbys"] = [s for s in standbys if s != info["leader"]]
+    info["standby_count"] = len(info["standbys"])
+    return info
 
 
 class HaJobSupervisor:

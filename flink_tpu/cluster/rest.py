@@ -47,16 +47,20 @@ class RestEndpoint:
         self._requested_port = port
         self._jobs: dict[str, Any] = {}          # name -> LocalJob
         self._coordinators: dict[str, Any] = {}  # name -> coordinator
+        self._ha_dirs: dict[str, str] = {}       # name -> HA dir (failover)
         self.metrics_registry = metrics_registry
         self.savepoint_timeout_s = savepoint_timeout_s
         self._server = None
         self.port: Optional[int] = None
 
     # -- registration ------------------------------------------------------
-    def register_job(self, name: str, job, coordinator=None) -> None:
+    def register_job(self, name: str, job, coordinator=None,
+                     ha_dir: Optional[str] = None) -> None:
         self._jobs[name] = job
         if coordinator is not None:
             self._coordinators[name] = coordinator
+        if ha_dir:
+            self._ha_dirs[name] = ha_dir
 
     # -- views -------------------------------------------------------------
     def _job_overview(self) -> list[dict]:
@@ -215,6 +219,19 @@ class RestEndpoint:
         view["enabled"] = ISOLATION.enabled
         return view
 
+    def _leader(self, name: str) -> Optional[dict]:
+        """Who leads this job's coordinator election (cluster/ha.py):
+        current leader owner, fencing epoch, lease age and the announced
+        standby roster. 404s for jobs registered without an HA dir —
+        a fixed-coordinator job has no leader to report."""
+        ha_dir = self._ha_dirs.get(name)
+        if name not in self._jobs or ha_dir is None:
+            return None
+        from .ha import leader_info
+        info = leader_info(ha_dir)
+        info["name"] = name
+        return info
+
     def _metrics_registry(self):
         """The bound registry, or a lazily-created one carrying only the
         process-global device scope — /metrics must expose compile and
@@ -357,6 +374,11 @@ class RestEndpoint:
                     q = endpoint._quota(parts[1])
                     self._reply(200 if q else 404,
                                 q or {"error": "no such job"})
+                elif (len(parts) == 3 and parts[0] == "jobs"
+                      and parts[2] == "leader"):
+                    ldr = endpoint._leader(parts[1])
+                    self._reply(200 if ldr else 404,
+                                ldr or {"error": "no such job"})
                 elif parts == ["metrics", "snapshot"]:
                     self._reply(200, endpoint._metrics_snapshot())
                 elif parts == ["metrics"]:

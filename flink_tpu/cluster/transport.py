@@ -570,8 +570,23 @@ class TransportServer:
                 pass
 
     def close(self) -> None:
+        """Idempotent: a host torn down by both a failover path and its
+        own shutdown must release the data port exactly once (a process
+        promoted on the same host re-binds immediately)."""
+        if self._stop.is_set():
+            return
         self._stop.set()
+        # shutdown() first: it wakes the thread blocked in accept(), whose
+        # in-flight syscall otherwise pins the socket in the kernel and
+        # keeps the port bound after close() (EADDRINUSE for a process
+        # promoted on the same host)
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._srv.close()
         except OSError:
             pass
+        if threading.current_thread() is not self._accept_thread:
+            self._accept_thread.join(timeout=1.0)

@@ -102,6 +102,12 @@ class DeviceStats:
         self._tier_hot_touches = 0
         self._tier_touches = 0
         self._tier_hbm_bytes = 0
+        # coordinator-failover accounting (PR 18): leader elections won
+        # per scope, takeovers completed per mode (hot/restore), and a
+        # bounded list of takeover durations for the failover histogram
+        self._leader_elections: dict[str, int] = {}
+        self._failovers: dict[str, int] = {}
+        self._takeover_ms: list[float] = []
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -221,6 +227,21 @@ class DeviceStats:
         with self._lock:
             self._net_errors[direction] = \
                 self._net_errors.get(direction, 0) + 1
+
+    # -- coordinator-failover accounting -------------------------------------
+    def note_leader_election(self, scope: str) -> None:
+        with self._lock:
+            self._leader_elections[scope] = \
+                self._leader_elections.get(scope, 0) + 1
+
+    def note_coordinator_failover(self, took_ms: float, mode: str) -> None:
+        """A standby finished taking over a running job: ``mode`` is
+        'hot' (all workers re-registered, no restart) or 'restore'
+        (fenced global restore from the latest verified checkpoint)."""
+        with self._lock:
+            self._failovers[mode] = self._failovers.get(mode, 0) + 1
+            self._takeover_ms.append(float(took_ms))
+            del self._takeover_ms[:-256]
 
     # -- incremental-fire / coalescing accounting ----------------------------
     def note_panes_sealed(self, n: int = 1) -> None:
@@ -359,6 +380,16 @@ class DeviceStats:
             return sum(self._net_errors.values())
 
     @property
+    def leader_elections(self) -> int:
+        with self._lock:
+            return sum(self._leader_elections.values())
+
+    @property
+    def coordinator_failovers(self) -> int:
+        with self._lock:
+            return sum(self._failovers.values())
+
+    @property
     def verify_failures(self) -> int:
         with self._lock:
             return sum(self._verify_failures.values())
@@ -441,6 +472,10 @@ class DeviceStats:
                 "zombies_fenced_total":
                     sum(self._zombies_fenced.values()),
                 "network_errors_total": sum(self._net_errors.values()),
+                "leader_elections_total":
+                    sum(self._leader_elections.values()),
+                "coordinator_failovers_total":
+                    sum(self._failovers.values()),
                 "spans_dropped_total": self._spans_dropped,
                 "panes_sealed_total": self._panes_sealed,
                 "batches_coalesced_total": self._batches_coalesced,
@@ -458,6 +493,12 @@ class DeviceStats:
                     self._tier_hot_touches / max(self._tier_touches, 1), 6),
                 "tier_hbm_bytes_in_use": self._tier_hbm_bytes,
             }
+            tk = sorted(self._takeover_ms)
+            out["takeover_duration_ms_count"] = len(tk)
+            out["takeover_duration_ms_p50"] = (
+                round(tk[len(tk) // 2], 3) if tk else 0.0)
+            out["takeover_duration_ms_max"] = (
+                round(tk[-1], 3) if tk else 0.0)
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
             for scope, n in sorted(self._retries.items()):
@@ -482,6 +523,10 @@ class DeviceStats:
                 out[f"zombies_fenced.{scope}"] = n
             for direction, n in sorted(self._net_errors.items()):
                 out[f"net_errors.{direction}"] = n
+            for scope, n in sorted(self._leader_elections.items()):
+                out[f"leader_elections.{scope}"] = n
+            for mode, n in sorted(self._failovers.items()):
+                out[f"coordinator_failovers.{mode}"] = n
             return out
 
     def reset(self) -> None:
@@ -502,6 +547,9 @@ class DeviceStats:
             self._frames_deduped.clear()
             self._zombies_fenced.clear()
             self._net_errors.clear()
+            self._leader_elections.clear()
+            self._failovers.clear()
+            self._takeover_ms.clear()
             self._spans_dropped = 0
             self._panes_sealed = 0
             self._batches_coalesced = 0
@@ -732,6 +780,11 @@ def bind_device_metrics(registry) -> None:
     g.gauge("frames_deduped_total", lambda: s.frames_deduped)
     g.gauge("zombies_fenced_total", lambda: s.zombies_fenced)
     g.gauge("network_errors_total", lambda: s.net_errors)
+    # coordinator failover (prometheus:
+    # flink_tpu_device_leader_elections_total /
+    # flink_tpu_device_coordinator_failovers_total)
+    g.gauge("leader_elections_total", lambda: s.leader_elections)
+    g.gauge("coordinator_failovers_total", lambda: s.coordinator_failovers)
     # tracing (prometheus: flink_tpu_device_spans_dropped_total)
     g.gauge("spans_dropped_total", lambda: s.spans_dropped)
     # incremental fire engine / coalesced ingest (prometheus:

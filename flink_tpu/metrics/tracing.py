@@ -527,6 +527,9 @@ SPAN_INVENTORY: tuple = (
      "(retries/degrade included)"),
     ("device", "H2D",
      "metrics/device.py note_h2d — host→device transfer"),
+    ("ha", "Takeover",
+     "cluster/distributed.py CoordinatorContender._on_grant — standby "
+     "promoted over a running job: grant → hot resume or fenced restore"),
     ("net", "Fence",
      "cluster/transport.py — zombie producer fenced by epoch check"),
     ("net", "Reconnect",

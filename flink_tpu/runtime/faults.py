@@ -41,6 +41,13 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     sched.shed        drop-style: force the admission gate to shed the
                       next micro-batch to the dead-letter output even
                       without real overload
+    coord.crash       drop-style: the LEADER coordinator crashes — drops
+                      its server plus every worker control socket and
+                      stops renewing its lease, so a standby can steal
+                      leadership and take the running job over
+    ha.lease          a leader-lease renew or steal attempt fails (or,
+                      with !hang@MS, stalls — the GC-pause analog that
+                      lets the lease expire under a live leader)
 
 Every rule also accepts a ``!hang@MS`` flag: the trip SLEEPS MS
 milliseconds at the site instead of raising — the deterministic stand-in
@@ -88,6 +95,7 @@ FAULT_SITES = (
     "bench.probe",
     "net.connect", "net.sever", "net.delay", "net.zombie",
     "sched.admit", "sched.shed",
+    "coord.crash", "ha.lease",
 )
 
 

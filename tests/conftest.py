@@ -33,16 +33,18 @@ def _clean_net_events():
 
 @pytest.fixture(autouse=True)
 def _stall_wall_clock_guard(request):
-    """Hard per-test wall-clock guard for `stall`-, `netfault`- and
-    `isolation`-marked tests: the stall watchdog's (or the reconnect or
-    admission-gate path's) own regressions must FAIL the suite, not hang
-    it. SIGALRM fires in the main thread and unwinds whatever wait the
-    test is blocked in (hang injections use <=50ms delays and reconnect
-    deadlines are a few seconds, so 120s means a real supervision bug,
-    not a slow box)."""
+    """Hard per-test wall-clock guard for `stall`-, `netfault`-,
+    `isolation`- and `failover`-marked tests: the stall watchdog's (or
+    the reconnect, admission-gate, or leader-election path's) own
+    regressions must FAIL the suite, not hang it. SIGALRM fires in the
+    main thread and unwinds whatever wait the test is blocked in (hang
+    injections use <=50ms delays and reconnect/lease deadlines are a
+    few seconds, so 120s means a real supervision bug, not a slow
+    box)."""
     if (request.node.get_closest_marker("stall") is None
             and request.node.get_closest_marker("netfault") is None
-            and request.node.get_closest_marker("isolation") is None):
+            and request.node.get_closest_marker("isolation") is None
+            and request.node.get_closest_marker("failover") is None):
         yield
         return
     import signal

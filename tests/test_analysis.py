@@ -269,6 +269,44 @@ def test_sched_inventory_rows_locked(tmp_path):
     assert "code-not-inventoried:sched.rogue" in f305
 
 
+def test_failover_inventory_rows_locked(tmp_path):
+    """The coordinator-failover observability contract is inventoried:
+    its chaos sites (coord.crash / ha.lease) are declared FAULT_SITES
+    members and its span (ha/Takeover) is in SPAN_INVENTORY — a mini
+    package exercising them draws no undeclared/rogue findings, while
+    lookalike rogues at the same scopes still do."""
+    ctx = _mini_pkg(tmp_path, {
+        "coord.py": """\
+            from .wiring import FAULTS, TRACER
+
+            def monitor(self):
+                if FAULTS.check("coord.crash"):
+                    return "crashed"
+                TRACER.span("ha", "Takeover").finish()
+                return "leading"
+
+            def renew(self):
+                if FAULTS.check("ha.lease"):
+                    return False
+                return True
+
+            def rogue(self):
+                FAULTS.fire("coord.split-brain")          # line 15
+                TRACER.span("ha", "Abdicate").finish()
+            """,
+    })
+    f301 = {f.symbol for f in run_rules(ctx, ["TPU301"])}
+    f302 = {f.symbol for f in run_rules(ctx, ["TPU302"])}
+    assert "code-not-inventoried:ha.Takeover" not in f301, \
+        "ha/Takeover: SPAN_INVENTORY row went missing"
+    for sym in ("undeclared-site:coord.crash",
+                "undeclared-site:ha.lease"):
+        assert sym not in f302, f"{sym}: FAULT_SITES member went missing"
+    # the lock still bites on undeclared lookalikes
+    assert "code-not-inventoried:ha.Abdicate" in f301
+    assert "undeclared-site:coord.split-brain" in f302
+
+
 def test_seeded_unlocked_mutation_detected(tmp_path):
     """A class that guards an attribute under self._lock in one method
     but mutates it bare in another is flagged with rule TPU401."""

@@ -1,0 +1,774 @@
+"""Coordinator failover drills (docs/ROBUSTNESS.md 'Coordinator failover'):
+leased leader election with multi-stealer contention, token-fenced HA
+writes, standby takeover of a RUNNING two-host job — hot (every worker
+re-registers, no restart, no recompile) and fenced restore (a worker died
+alongside the leader) — double failover, deposed zombie-leader
+self-fencing, the CLI/REST leader surface, and the kill -9 acceptance
+drill with committed FileSink output asserted against the deterministic
+oracle of the keyed running sum.
+
+Reference model: DefaultLeaderElectionService + JobMaster fencing tokens +
+Dispatcher recovery (SURVEY §2.3), collapsed onto the shared-filesystem
+lease in cluster/ha.py."""
+
+import json
+import os
+import pickle
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cluster.distributed import (
+    CoordinatorContender, DistributedHost, _Coordinator,
+)
+from flink_tpu.cluster.ha import (
+    FileHaServices, _Lease, leader_info, read_leader_record,
+)
+from flink_tpu.cluster.transport import TransportServer
+from flink_tpu.connectors.core import CollectSink
+from flink_tpu.core.config import (
+    CheckpointingOptions, Configuration, HaOptions, PipelineOptions,
+    RuntimeOptions,
+)
+from flink_tpu.core.records import Schema
+from flink_tpu.metrics.device import DEVICE_STATS
+
+pytestmark = pytest.mark.failover
+
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+
+
+# -- pipeline/config helpers (SPMD: every host AND every master builds the
+# identical graph locally; only the journal's numbers ride the HA store) ----
+
+def _ha_env(ckpt_dir, lease=0.5, takeover=15.0):
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.config.set(PipelineOptions.BATCH_SIZE, 8)
+    env.config.set(CheckpointingOptions.INTERVAL, 0.1)
+    env.config.set(CheckpointingOptions.DIRECTORY, ckpt_dir)
+    env.config.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.2)
+    env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
+    env.config.set(RuntimeOptions.RESTART_ATTEMPTS, 5)
+    env.config.set(RuntimeOptions.RESTART_DELAY, 0.1)
+    env.config.set(HaOptions.LEASE_TIMEOUT, lease)
+    env.config.set(HaOptions.TAKEOVER_TIMEOUT, takeover)
+    return env
+
+
+def _keyed_sum_graph(env, name, count, rate):
+    """Paced datagen -> keyed running sum -> CollectSink. Values are
+    strictly positive (idx + 1) so per-key running sums strictly increase
+    — output-value distinctness doubles as a duplicate-commit detector."""
+    sink = CollectSink()
+
+    def gen(idx):
+        return {"k": idx % 7, "v": idx + 1}
+
+    ds = env.datagen(gen, SCHEMA, count=count, rate_per_sec=rate)
+    ds.key_by("k").sum(1).add_sink(sink, "sink")
+    return env.get_job_graph(name), sink
+
+
+def _expect_finals(count):
+    return {k: sum(i + 1 for i in range(count) if i % 7 == k)
+            for k in range(7)}
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+# -- the lease: multi-stealer contention property ---------------------------
+
+def test_lease_multi_stealer_single_winner_monotonic_epochs(tmp_path):
+    """Seeded property drill: 8 contenders steal one expired lease per
+    round. Exactly one try_acquire wins each round (the whole
+    check-steal-grant sequence is flocked), and the fencing epoch
+    increments by exactly one per grant — strictly monotonic, never
+    reused, never skipped by a losing stealer."""
+    rnd = random.Random(0xF417)
+    ha_dir = str(tmp_path / "ha")
+    timeout = 0.25
+    first = _Lease(ha_dir, "initial", timeout)
+    assert first.try_acquire()
+    last_epoch = first.token
+    contenders = [_Lease(ha_dir, f"c{i}", timeout) for i in range(8)]
+    for round_no in range(4):
+        time.sleep(timeout + 0.1)  # nobody renews: the holder expires
+        winners = []
+        barrier = threading.Barrier(len(contenders))
+
+        def contend(lease, delay):
+            barrier.wait()
+            time.sleep(delay)
+            if lease.try_acquire():
+                winners.append(lease)
+
+        threads = [threading.Thread(
+            target=contend, args=(lease, rnd.uniform(0.0, 0.02)),
+            daemon=True) for lease in contenders]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(winners) == 1, \
+            f"round {round_no}: {len(winners)} winners " \
+            f"({[w.owner for w in winners]})"
+        assert winners[0].token == last_epoch + 1, \
+            f"round {round_no}: epoch {winners[0].token} after {last_epoch}"
+        last_epoch = winners[0].token
+
+
+def test_stale_token_writes_never_clobber_successor(tmp_path):
+    """Every HA write is fenced twice: against the recorded token AND the
+    CURRENT lease holder's token. A deposed owner's late writes — journal,
+    checkpoint pointer, job result, leader record — all lose, even before
+    the successor has written anything."""
+    ha_dir = str(tmp_path / "ha")
+    ha = FileHaServices(ha_dir)
+    old = _Lease(ha_dir, "old", 0.2)
+    assert old.try_acquire()
+    t_old = old.token
+    assert ha.publish_leader_record(t_old, "127.0.0.1:1111", "old")
+    assert ha.put_journal("j", t_old, {"epoch": 0, "owner": "old"})
+    assert ha.put_checkpoint("j", t_old, {"checkpoint_id": 1})
+
+    time.sleep(0.3)  # lease expires un-renewed (the owner is dead)
+    new = _Lease(ha_dir, "new", 0.2)
+    assert new.try_acquire()
+    t_new = new.token
+    assert t_new > t_old
+    # the successor holds the lease but wrote NOTHING yet: the deposed
+    # owner's write must already lose against the lease token alone
+    assert ha.put_result("j", t_old, {"status": "done"}) is False
+
+    assert ha.publish_leader_record(t_new, "127.0.0.1:2222", "new")
+    assert ha.put_journal("j", t_new, {"epoch": 0, "owner": "new"})
+    assert ha.put_checkpoint("j", t_new, {"checkpoint_id": 2})
+    assert ha.put_result("j", t_new, {"status": "done", "owner": "new"})
+
+    # the zombie's whole write surface is refused...
+    assert ha.put_checkpoint("j", t_old, {"checkpoint_id": 99}) is False
+    assert ha.put_journal("j", t_old, {"epoch": 9}) is False
+    assert ha.put_result("j", t_old, {"status": "done", "o": "old"}) is False
+    assert ha.publish_leader_record(t_old, "127.0.0.1:9999", "old") is False
+    # ...and the successor's records are intact
+    assert ha.get_checkpoint("j")["checkpoint_id"] == 2
+    assert ha.get_journal("j")["owner"] == "new"
+    assert ha.get_result("j")["owner"] == "new"
+    assert read_leader_record(ha_dir)["address"] == "127.0.0.1:2222"
+
+
+def test_ha_lease_fault_site(tmp_path):
+    """The ``ha.lease`` chaos site: a drop-style trip fails that acquire
+    or renew attempt; the ``!hang@MS`` form sleeps instead — the GC-pause
+    analog that delays but does not itself fail the operation."""
+    from flink_tpu.runtime.faults import FAULTS
+    ha_dir = str(tmp_path / "ha")
+    try:
+        FAULTS.configure_spec("ha.lease=once@1", seed=3)
+        lease = _Lease(ha_dir, "m", 5.0)
+        assert lease.try_acquire() is False   # tripped: attempt fails
+        assert lease.try_acquire() is True    # once@1 exhausted
+        FAULTS.configure_spec("ha.lease=once@1!hang@50", seed=3)
+        t0 = time.monotonic()
+        assert lease.renew() is True          # delayed, not failed
+        assert time.monotonic() - t0 >= 0.045
+    finally:
+        FAULTS.reset()
+
+
+# -- deposed zombie leader --------------------------------------------------
+
+def test_deposed_zombie_leader_self_fences(tmp_path):
+    """A leader whose lease was stolen learns it through its next fenced
+    HA write: the refusal deposes it — sockets drop, on_deposed fires,
+    the failure history records 'leader-deposed', the zombie counter
+    bumps — and its port is immediately reusable by the successor."""
+    ha_dir = str(tmp_path / "ha")
+    ha = FileHaServices(ha_dir)
+    zombie_lease = _Lease(ha_dir, "zombie", 0.25)
+    assert zombie_lease.try_acquire()
+    cfg = Configuration()
+    coord = _Coordinator(1, cfg, ha=ha, token=zombie_lease.token,
+                         job_id="zjob", owner="zombie")
+    deposed_calls = []
+    coord.on_deposed = lambda: deposed_calls.append(1)
+    assert coord._journal_ha("claim") is True
+
+    time.sleep(0.35)  # lease expires; a standby steals it
+    heir = _Lease(ha_dir, "heir", 0.25)
+    assert heir.try_acquire()
+    assert heir.token > zombie_lease.token
+
+    zf0 = DEVICE_STATS.snapshot().get("zombies_fenced_total", 0)
+    assert coord._journal_ha("late-write") is False
+    assert coord._deposed.is_set()
+    assert deposed_calls == [1]
+    assert coord._closed is True
+    assert "leader-deposed" in [e["kind"] for e in coord.failure_history]
+    assert DEVICE_STATS.snapshot().get("zombies_fenced_total", 0) > zf0
+    # second fenced write: depose is idempotent, the callback fires once
+    coord._depose("again")
+    assert deposed_calls == [1]
+    # the zombie's close released its port: the heir binds it directly
+    succ = _Coordinator(1, cfg, port=coord.port, ha=ha, token=heir.token,
+                        job_id="zjob", owner="heir")
+    assert succ.port == coord.port
+    succ.close()
+
+
+# -- close idempotency + port release ---------------------------------------
+
+def test_close_idempotent_and_ports_released():
+    """Double-close every layer — coordinator, transport, host — then
+    rebind the released ports: no EADDRINUSE, no raise on the second
+    close (the contender's revoke path, the depose path and host
+    shutdown may all race onto close())."""
+    cfg = Configuration()
+    c = _Coordinator(1, cfg)
+    port = c.port
+    c.close()
+    c.close()
+    c2 = _Coordinator(1, cfg, port=port)
+    assert c2.port == port
+    c2.close()
+    c2.close()
+
+    srv = TransportServer()
+    t_port = srv.port
+    srv.close()
+    srv.close()
+    srv2 = TransportServer(port=t_port)
+    assert srv2.port == t_port
+    srv2.close()
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(1)
+    ds = env.from_collection([(0, 1)], SCHEMA, timestamps=[0])
+    ds.add_sink(CollectSink(), "sink")
+    jg = env.get_job_graph("closer")
+    host = DistributedHost(jg, env.config, 0, 1)
+    host.close()
+    host.close()
+
+
+# -- the leader surface: CLI + REST -----------------------------------------
+
+def _publish_leader(ha_dir):
+    lease = _Lease(ha_dir, "m-one", 30.0)
+    assert lease.try_acquire()
+    ha = FileHaServices(ha_dir)
+    assert ha.publish_leader_record(lease.token, "127.0.0.1:7777", "m-one")
+    ha.announce_standby("m-one")
+    ha.announce_standby("m-two")
+    return lease
+
+
+def test_cli_leader(tmp_path, capsys):
+    from flink_tpu.cli import main as cli_main
+    ha_dir = str(tmp_path / "ha")
+    os.makedirs(ha_dir)
+    assert cli_main(["leader", ha_dir]) == 1
+    assert "no leader" in capsys.readouterr().out
+
+    lease = _publish_leader(ha_dir)
+    assert cli_main(["leader", ha_dir]) == 0
+    out = capsys.readouterr().out
+    assert "m-one" in out and "127.0.0.1:7777" in out
+    assert f"epoch:    {lease.token}" in out
+
+    assert cli_main(["leader", ha_dir, "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["leader"] == "m-one"
+    assert rec["epoch"] == lease.token
+    assert rec["address"] == "127.0.0.1:7777"
+    assert rec["standbys"] == ["m-two"]  # the leader is not its own standby
+
+
+def test_rest_leader_route(tmp_path):
+    from flink_tpu.cluster.rest import RestEndpoint
+    ha_dir = str(tmp_path / "ha")
+    _publish_leader(ha_dir)
+    ep = RestEndpoint(port=0)
+    ep.register_job("hajob", SimpleNamespace(failure_history=[]),
+                    ha_dir=ha_dir)
+    ep.register_job("plain", SimpleNamespace(failure_history=[]))
+    info = ep._leader("hajob")
+    assert info["leader"] == "m-one" and info["name"] == "hajob"
+    assert ep._leader("plain") is None     # no HA dir: nothing to lead
+    assert ep._leader("ghost") is None
+    port = ep.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/jobs/hajob/leader",
+                timeout=5) as r:
+            body = json.loads(r.read())
+        assert body["leader"] == "m-one"
+        assert body["address"] == "127.0.0.1:7777"
+        assert body["standby_count"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/jobs/plain/leader", timeout=5)
+        assert ei.value.code == 404
+    finally:
+        ep.stop()
+
+
+# -- live takeover of a running two-host job (in process) -------------------
+
+def _start_cluster(tmp_path, count, rate, lease, takeover, n_masters=2):
+    """Two DistributedHost workers (threads) + n standby masters over one
+    HA dir. Returns (hosts, peers, sinks, contenders, errors, threads)."""
+    ha_dir = str(tmp_path / "ha")
+    ckpt_dir = str(tmp_path / "chk")
+    graphs, sinks = [], []
+    for h in range(2):
+        env = _ha_env(ckpt_dir, lease=lease, takeover=takeover)
+        jg, sink = _keyed_sum_graph(env, "ha-job", count, rate)
+        graphs.append((jg, env.config))
+        sinks.append(sink)
+    hosts = [DistributedHost(graphs[h][0], graphs[h][1], h, 2,
+                             ha_dir=ha_dir) for h in range(2)]
+    peers = {h: hosts[h].data_address for h in range(2)}
+    contenders = []
+    for i in range(n_masters):
+        env = _ha_env(ckpt_dir, lease=lease, takeover=takeover)
+        jg, _ = _keyed_sum_graph(env, "ha-job", count, rate)
+        contenders.append(CoordinatorContender(
+            jg, env.config, ha_dir, 2, owner=f"m{i + 1}").start())
+    errors = {}
+
+    def run_worker(host, idx):
+        try:
+            host.run(peers, timeout=90)
+        except Exception as e:  # noqa: BLE001 - asserted by the caller
+            errors[idx] = e
+
+    threads = [threading.Thread(target=run_worker, args=(hosts[h], h),
+                                daemon=True) for h in range(2)]
+    for t in threads:
+        t.start()
+    return ha_dir, hosts, sinks, contenders, errors, threads
+
+
+def _wait_leader_with_checkpoints(contenders, n_ckpts, deadline_s=45):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for c in contenders:
+            coord = c.coordinator
+            if (c.election.is_leader() and coord is not None
+                    and len(coord.completed) >= n_ckpts):
+                return c
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no leader reached {n_ckpts} completed checkpoints")
+
+
+def _wait_counter(key, floor, deadline_s=40):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if DEVICE_STATS.snapshot().get(key, 0) >= floor:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"{key} never reached {floor} "
+        f"(now {DEVICE_STATS.snapshot().get(key, 0)})")
+
+
+def _cleanup(contenders, hosts):
+    for c in contenders:
+        try:
+            c.kill()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+    for h in hosts:
+        try:
+            h.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+
+def test_hot_takeover_no_restart_no_recompile(tmp_path):
+    """Kill the leading master mid-job with both workers healthy: the
+    standby steals the lease, publishes its record, both workers
+    re-register within ha.takeover-timeout and the takeover resolves HOT
+    — restarts == 0, recompiles == 0 across the takeover window, the
+    attempt epoch never bumps, the output stays exactly-once, and the
+    failover is observable (counter, flight-recorder dump, leader
+    record)."""
+    from flink_tpu.metrics.tracing import FLIGHT_RECORDER
+    count = 900
+    ha_dir, hosts, sinks, contenders, errors, threads = _start_cluster(
+        tmp_path, count=count, rate=150.0, lease=0.5, takeover=15.0)
+    try:
+        leader = _wait_leader_with_checkpoints(contenders, 2)
+        standby = next(c for c in contenders if c is not leader)
+        snap0 = DEVICE_STATS.snapshot()
+        hot0 = snap0.get("coordinator_failovers.hot", 0)
+        elections0 = snap0["leader_elections_total"]
+        tk_count0 = snap0["takeover_duration_ms_count"]
+        compiles_at_kill = DEVICE_STATS.compiles
+        dumps0 = len(FLIGHT_RECORDER.dumps)
+
+        leader.kill()  # SIGKILL analog: lease NOT released, sockets drop
+
+        _wait_counter("coordinator_failovers.hot", hot0 + 1)
+        # hot takeover compiled nothing: the data plane never redeployed
+        assert DEVICE_STATS.compiles == compiles_at_kill
+
+        result = standby.run(timeout=90)
+        for t in threads:
+            t.join(90)
+        assert not any(t.is_alive() for t in threads)
+        assert errors == {}, errors
+        assert result["status"] == "done"
+        assert result["owner"] == standby.owner
+        assert result["restarts"] == 0
+        assert result["epoch"] == 0   # hot takeover keeps the attempt epoch
+        for h in hosts:
+            assert h._epoch == 0 and h.fenced is False
+
+        snap = DEVICE_STATS.snapshot()
+        assert snap["leader_elections_total"] >= elections0 + 1
+        assert snap["takeover_duration_ms_count"] >= tk_count0 + 1
+        assert snap["takeover_duration_ms_max"] > 0.0
+        failover_dumps = [d for d in FLIGHT_RECORDER.dumps[dumps0:]
+                          if d["reason"] == "failover"]
+        assert failover_dumps, "takeover produced no flight-recorder dump"
+        assert failover_dumps[-1]["mode"] == "hot"
+        assert os.path.basename(failover_dumps[-1]["path"]).startswith(
+            "flight-failover-")
+        info = leader_info(ha_dir)
+        assert info["leader"] == standby.owner  # record names the survivor
+
+        rows = sinks[0].rows + sinks[1].rows
+        assert len(rows) == count   # no restart: nothing replayed or lost
+        finals = {}
+        for k, v in rows:
+            finals[k] = max(finals.get(k, 0), v)
+        assert finals == _expect_finals(count)
+    finally:
+        _cleanup(contenders, hosts)
+
+
+def test_takeover_with_restore_when_worker_died(tmp_path):
+    """Kill the leader AND worker 1 together: worker 0 re-registers with
+    the successor but worker 1 never does, so ha.takeover-timeout expires
+    and the successor falls back to a fenced global restore from the
+    journaled checkpoint — restarts >= 1, epoch bumps, final sums stay
+    exact (exactly-once either way)."""
+    count = 800
+    _, hosts, sinks, contenders, errors, threads = _start_cluster(
+        tmp_path, count=count, rate=150.0, lease=0.5, takeover=1.5)
+    try:
+        leader = _wait_leader_with_checkpoints(contenders, 1)
+        standby = next(c for c in contenders if c is not leader)
+        restore0 = DEVICE_STATS.snapshot().get(
+            "coordinator_failovers.restore", 0)
+
+        leader.kill()
+        hosts[1].close()   # died alongside the leader
+
+        _wait_counter("coordinator_failovers.restore", restore0 + 1,
+                      deadline_s=60)
+        result = standby.run(timeout=90)
+        threads[0].join(90)
+        threads[1].join(10)
+        assert not threads[0].is_alive()
+        assert 0 not in errors, errors   # the survivor must not fail
+        assert result["status"] == "done"
+        assert result["restarts"] >= 1
+        assert result["epoch"] >= 1
+        assert hosts[0]._epoch >= 1
+
+        # exactly-once across the replay: the survivor re-ran the dead
+        # worker's subtasks from the checkpoint; CollectSink rows are
+        # non-transactional so use the replay-invariant max-per-key
+        rows = sinks[0].rows + sinks[1].rows
+        finals = {}
+        for k, v in rows:
+            finals[k] = max(finals.get(k, 0), v)
+        assert finals == _expect_finals(count)
+    finally:
+        _cleanup(contenders, hosts)
+
+
+def test_double_failover(tmp_path):
+    """Three masters, two kills: each takeover resolves hot (both workers
+    stay up), the third master finishes the job with zero restarts and
+    exactly two recorded failovers."""
+    count = 1800
+    _, hosts, sinks, contenders, errors, threads = _start_cluster(
+        tmp_path, count=count, rate=120.0, lease=0.5, takeover=15.0,
+        n_masters=3)
+    try:
+        hot0 = DEVICE_STATS.snapshot().get("coordinator_failovers.hot", 0)
+        leader1 = _wait_leader_with_checkpoints(contenders, 1)
+        leader1.kill()
+        _wait_counter("coordinator_failovers.hot", hot0 + 1)
+
+        remaining = [c for c in contenders if c is not leader1]
+        leader2 = _wait_leader_with_checkpoints(remaining, 1)
+        leader2.kill()
+        _wait_counter("coordinator_failovers.hot", hot0 + 2)
+
+        last = next(c for c in remaining if c is not leader2)
+        result = last.run(timeout=120)
+        for t in threads:
+            t.join(90)
+        assert not any(t.is_alive() for t in threads)
+        assert errors == {}, errors
+        assert result["status"] == "done"
+        assert result["owner"] == last.owner
+        assert result["restarts"] == 0
+        assert DEVICE_STATS.snapshot().get(
+            "coordinator_failovers.hot", 0) == hot0 + 2
+
+        rows = sinks[0].rows + sinks[1].rows
+        assert len(rows) == count
+        finals = {}
+        for k, v in rows:
+            finals[k] = max(finals.get(k, 0), v)
+        assert finals == _expect_finals(count)
+    finally:
+        _cleanup(contenders, hosts)
+
+
+# -- the acceptance drill: kill -9 the leader MASTER PROCESS ----------------
+
+MASTER_SCRIPT = r"""
+import pickle, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cluster.distributed import CoordinatorContender
+from flink_tpu.connectors.file import FileSink
+from flink_tpu.formats.core import CsvFormat
+from flink_tpu.core.config import (
+    CheckpointingOptions, HaOptions, PipelineOptions, RuntimeOptions,
+)
+from flink_tpu.core.records import Schema
+
+owner = sys.argv[1]
+out_file = sys.argv[2]
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+env = StreamExecutionEnvironment()
+env.set_parallelism(2)
+env.config.set(PipelineOptions.BATCH_SIZE, 8)
+env.config.set(CheckpointingOptions.INTERVAL, 0.15)
+env.config.set(CheckpointingOptions.DIRECTORY, {ckpt_dir!r})
+env.config.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.2)
+env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
+env.config.set(RuntimeOptions.RESTART_ATTEMPTS, 5)
+env.config.set(RuntimeOptions.RESTART_DELAY, 0.1)
+env.config.set(HaOptions.LEASE_TIMEOUT, 1.0)
+env.config.set(HaOptions.TAKEOVER_TIMEOUT, 20.0)
+
+n = 1200
+def gen(idx):
+    return {{"k": idx % 7, "v": idx + 1}}
+
+ds = env.datagen(gen, SCHEMA, count=n, rate_per_sec=80.0)
+ds.key_by("k").sum(1).sink_to(
+    FileSink({out_dir!r}, CsvFormat(SCHEMA)), "sink")
+jg = env.get_job_graph("ha-drill")
+
+c = CoordinatorContender(jg, env.config, {ha_dir!r}, 2, owner=owner)
+result = c.run(timeout=110)
+from flink_tpu.metrics.device import DEVICE_STATS
+snap = DEVICE_STATS.snapshot()
+with open(out_file, "wb") as f:
+    pickle.dump({{"result": result,
+                  "failovers": snap["coordinator_failovers_total"],
+                  "hot": snap.get("coordinator_failovers.hot", 0),
+                  "elections": snap["leader_elections_total"]}}, f)
+"""
+
+HA_WORKER_SCRIPT = r"""
+import pickle, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.cluster.distributed import DistributedHost
+from flink_tpu.connectors.file import FileSink
+from flink_tpu.formats.core import CsvFormat
+from flink_tpu.core.config import (
+    CheckpointingOptions, HaOptions, PipelineOptions, RuntimeOptions,
+)
+from flink_tpu.core.records import Schema
+
+host_id = int(sys.argv[1])
+out_file = sys.argv[2]
+SCHEMA = Schema([("k", np.int64), ("v", np.int64)])
+env = StreamExecutionEnvironment()
+env.set_parallelism(2)
+env.config.set(PipelineOptions.BATCH_SIZE, 8)
+env.config.set(CheckpointingOptions.INTERVAL, 0.15)
+env.config.set(CheckpointingOptions.DIRECTORY, {ckpt_dir!r})
+env.config.set(RuntimeOptions.HEARTBEAT_INTERVAL, 0.2)
+env.config.set(RuntimeOptions.RESTART_STRATEGY, "fixed-delay")
+env.config.set(RuntimeOptions.RESTART_ATTEMPTS, 5)
+env.config.set(RuntimeOptions.RESTART_DELAY, 0.1)
+env.config.set(HaOptions.LEASE_TIMEOUT, 1.0)
+env.config.set(HaOptions.TAKEOVER_TIMEOUT, 20.0)
+
+n = 1200
+def gen(idx):
+    return {{"k": idx % 7, "v": idx + 1}}
+
+ds = env.datagen(gen, SCHEMA, count=n, rate_per_sec=80.0)
+ds.key_by("k").sum(1).sink_to(
+    FileSink({out_dir!r}, CsvFormat(SCHEMA)), "sink")
+jg = env.get_job_graph("ha-drill")
+
+DATA_PORTS = {ports!r}
+host = DistributedHost(jg, env.config, host_id, 2,
+                       data_port=DATA_PORTS[host_id],
+                       ha_dir={ha_dir!r})
+peers = {{i: ("127.0.0.1", DATA_PORTS[i]) for i in (0, 1)}}
+host.run(peers, timeout=110)
+with open(out_file, "wb") as f:
+    pickle.dump({{"epoch": host._epoch, "fenced": host.fenced}}, f)
+host.close()
+"""
+
+
+def test_kill9_leader_mid_checkpoint_acceptance_drill():
+    """The ISSUE's key drill, with REAL processes: a two-host job plus a
+    standby master; ``kill -9`` the leading master once checkpoints are
+    flowing. The standby acquires the lease within ha.lease-timeout,
+    both workers re-register (hot takeover: restarts == 0, attempt epoch
+    stays 0), coordinator_failovers_total == 1, and the committed
+    FileSink output is byte-identical to a clean run's (asserted through
+    the interleaving-invariant oracle: exact cardinality, per-key
+    distinct running sums, exact final per-key sums — two racing source
+    subtasks make raw line order nondeterministic even without faults)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tmp = tempfile.mkdtemp()
+    ha_dir = os.path.join(tmp, "ha")
+    ckpt_dir = os.path.join(tmp, "chk")
+    out_dir = os.path.join(tmp, "out")
+    os.makedirs(out_dir)
+    p0, p1 = _free_ports(2)
+    master_src = MASTER_SCRIPT.format(repo=repo, ckpt_dir=ckpt_dir,
+                                      out_dir=out_dir, ha_dir=ha_dir)
+    worker_src = HA_WORKER_SCRIPT.format(repo=repo, ckpt_dir=ckpt_dir,
+                                         out_dir=out_dir, ha_dir=ha_dir,
+                                         ports={0: p0, 1: p1})
+    master_path = os.path.join(tmp, "master.py")
+    worker_path = os.path.join(tmp, "worker.py")
+    with open(master_path, "w") as f:
+        f.write(master_src)
+    with open(worker_path, "w") as f:
+        f.write(worker_src)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    m_outs = [os.path.join(tmp, f"master-{i}.pkl") for i in (1, 2)]
+    w_outs = [os.path.join(tmp, f"worker-{i}.pkl") for i in (0, 1)]
+
+    m1 = subprocess.Popen([sys.executable, master_path, "m1", m_outs[0]],
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          env=env)
+    # m1 must be THE leader before the standby even contends
+    deadline = time.time() + 60
+    while True:
+        rec = read_leader_record(ha_dir)
+        if rec is not None and rec["owner"] == "m1":
+            break
+        assert time.time() < deadline, "m1 never published a leader record"
+        assert m1.poll() is None, m1.communicate()[1].decode()[-3000:]
+        time.sleep(0.1)
+    m2 = subprocess.Popen([sys.executable, master_path, "m2", m_outs[1]],
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          env=env)
+    workers = [subprocess.Popen(
+        [sys.executable, worker_path, str(i), w_outs[i]],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+        for i in (0, 1)]
+    procs = [m1, m2] + workers
+
+    # wait for checkpoints to flow (a completed checkpoint needs BOTH
+    # workers registered and acking), then SIGKILL the leader — with a
+    # 0.15s trigger cadence the kill lands mid-checkpoint
+    deadline = time.time() + 60
+    while not os.path.isdir(ckpt_dir) or not any(
+            f.startswith("chk-") for f in os.listdir(ckpt_dir)):
+        if time.time() >= deadline:
+            for q in procs:
+                q.kill()
+            pytest.fail("no checkpoint appeared before the kill")
+        assert m1.poll() is None, m1.communicate()[1].decode()[-3000:]
+        time.sleep(0.05)
+    m1.send_signal(signal.SIGKILL)
+    m1.wait()
+    assert m1.returncode != 0   # really died uncleanly
+
+    errs = {}
+    for name, p in (("m2", m2), ("w0", workers[0]), ("w1", workers[1])):
+        try:
+            _, err = p.communicate(timeout=110)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"{name} did not finish after the leader kill")
+        errs[name] = err.decode()[-3000:]
+        assert p.returncode == 0, f"{name}: {errs[name]}"
+
+    with open(m_outs[1], "rb") as f:
+        standby = pickle.load(f)
+    assert standby["result"]["status"] == "done", standby
+    assert standby["result"]["owner"] == "m2", standby
+    assert standby["result"]["restarts"] == 0, standby   # hot takeover
+    assert standby["failovers"] == 1, standby
+    assert standby["hot"] == 1, standby
+    assert standby["elections"] >= 1, standby
+    for path in w_outs:
+        with open(path, "rb") as f:
+            wdata = pickle.load(f)
+        assert wdata["epoch"] == 0, wdata    # no restart ever ordered
+        assert wdata["fenced"] is False, wdata
+
+    # committed output == clean run's, on every interleaving-invariant
+    # property (the zombie drill's oracle): exact cardinality, per-key
+    # distinct values, exact final per-key sums
+    rows = []
+    for name in os.listdir(out_dir):
+        if name.startswith("."):
+            continue  # in-progress/pending staging never counts
+        with open(os.path.join(out_dir, name)) as f:
+            for line in f:
+                if line.strip():
+                    k, v = line.strip().split(",")
+                    rows.append((int(k), int(v)))
+    n = 1200  # keep in sync with MASTER_SCRIPT / HA_WORKER_SCRIPT
+    assert len(rows) == n, f"committed {len(rows)} rows, expected {n}"
+    by_key: dict = {}
+    for k, v in rows:
+        by_key.setdefault(k, []).append(v)
+    expect_counts = {k: sum(1 for i in range(n) if i % 7 == k)
+                     for k in range(7)}
+    assert {k: len(vs) for k, vs in by_key.items()} == expect_counts
+    for k, vs in by_key.items():
+        assert len(set(vs)) == len(vs), f"duplicated commit for key {k}"
+    assert {k: max(vs) for k, vs in by_key.items()} == _expect_finals(n)
