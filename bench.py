@@ -247,6 +247,19 @@ def _collect_metrics(env, before: dict) -> dict:
     # after a standby coordinator took over a running job)
     for k in ("takeover_duration_ms_p50", "takeover_duration_ms_max"):
         out[k] = snap.get(k, 0)
+    # AOT executable-cache counters (deltas): persistent-cache hit/miss
+    # accounting, store/fallback events, in-memory LRU evictions, and
+    # live XLA compiles taken while the persistent cache was active
+    # (compile storms — 0 on a properly warmed process)
+    for k in ("aot_hits_total", "aot_misses_total", "aot_stores_total",
+              "aot_fallbacks_total", "aot_in_memory_evictions_total",
+              "compile_storms_total"):
+        out[k] = snap.get(k, 0) - before.get(k, 0)
+    # cold-start readings (point-in-time): ms from AOT-enabled process
+    # start to the first device->host transfer (first fired window)
+    for k in ("cold_start_ms_count", "cold_start_ms_p50",
+              "cold_start_ms_max"):
+        out[k] = snap.get(k, 0)
     busy = bp = elapsed = 0.0
     for task in env.last_job.tasks.values():
         t = getattr(task, "io_timers", None)
@@ -502,6 +515,10 @@ CHAOS_SPEC = ("device.compile=once@2,device.execute=p0.05,"
               # two-tenant starvation drills are asserted exactly in
               # tests/test_isolation.py
               "sched.admit=every@7!hang@5,sched.shed=once@4,"
+              # AOT executable-cache sites: no-ops unless the run sets
+              # aot.dir (the corrupt-artifact and store-failure drills
+              # are asserted exactly in tests/test_aot.py)
+              "aot.load=once@1,aot.store=once@1,"
               # coordinator-failover site: a no-op here (only the
               # distributed leader's monitor loop visits it — a local run
               # has no elected coordinator); the kill-the-leader drills
@@ -1447,6 +1464,103 @@ def multichip(device_counts=(1, 2, 4, 8), batch: int = 4096,
     sys.stdout.flush()
 
 
+def _coldstart_worker(aot_dir: str, batch: int, n_batches: int) -> None:
+    """Runs in a SUBPROCESS (XLA compile caches are process-scoped, so
+    cold vs warmed must be separate processes): ONE tiny-Q5 pass — no
+    in-process warmup — with the persistent AOT cache pointed at
+    ``aot_dir``; prints one JSON line with the time-to-first-fired-window
+    and the AOT hit/storm accounting. The first invocation against an
+    empty dir is the COLD run (it compiles, and populates the cache);
+    the second is the WARMED run (it must not compile at all)."""
+    wall, _lat, rows, stages = _run_q5(
+        1000, n_batches * batch, 1 << 14, batch=batch,
+        extra_config={"aot.enabled": True, "aot.dir": aot_dir})
+    first_fire_ms = (stages.get("cold_start_ms_max")
+                     or round(wall * 1e3, 1))
+    print(json.dumps({
+        "first_fire_ms": round(first_fire_ms, 1),
+        "wall_s": round(wall, 4),
+        "emitted_rows": rows,
+        "recompiles": stages.get("recompiles", -1),
+        "compile_storms": stages.get("compile_storms_total", -1),
+        "aot_hits": stages.get("aot_hits_total", 0),
+        "aot_misses": stages.get("aot_misses_total", 0),
+        "aot_stores": stages.get("aot_stores_total", 0),
+        "aot_fallbacks": stages.get("aot_fallbacks_total", 0)}))
+
+
+def coldstart(batch: int = 1 << 12, n_batches: int = 8) -> None:
+    """`python bench.py --coldstart`: the compile-storm-free recovery
+    acceptance drill. Two subprocesses share one persistent AOT cache
+    directory: the COLD run starts with an empty cache (every program is
+    a live XLA compile, each counted as a compile storm, and each stored
+    as a verified artifact); the WARMED run starts a fresh process
+    against the populated cache and must reach its first fired window
+    with ZERO live compiles (recompiles == 0, compile_storms == 0,
+    aot_hits == the cold run's program count). The report's
+    ``first_fire_speedup`` is cold/warmed time-to-first-fired-window —
+    the acceptance bar is >= 3x on the CPU-fallback rung. Results land
+    in COLDSTART_rXX.json."""
+    import glob
+    import re
+    import shutil
+    import tempfile
+
+    rec = {"metric": "coldstart_report", "unit": "report", "rc": 0,
+           "ok": True, "tail": "", "batch": batch, "n_batches": n_batches,
+           "runs": {}}
+    aot_dir = tempfile.mkdtemp(prefix="flink_tpu_aot_")
+    try:
+        for label in ("cold", "warmed"):
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--coldstart-worker", aot_dir, "--batch", str(batch),
+                   "--n-batches", str(n_batches)]
+            try:
+                p = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=900, env=env)
+            except subprocess.TimeoutExpired:
+                rec.update(ok=False, rc=124,
+                           tail=f"{label} worker timed out")
+                break
+            line = (p.stdout.strip().splitlines() or [""])[-1]
+            try:
+                out = json.loads(line)
+            except ValueError:
+                out = {}
+            if p.returncode != 0 or "first_fire_ms" not in out:
+                rec.update(ok=False, rc=p.returncode or 1,
+                           tail=(p.stderr or line)[-400:])
+                break
+            rec["runs"][label] = out
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+    cold, warm = rec["runs"].get("cold"), rec["runs"].get("warmed")
+    if cold and warm:
+        rec["first_fire_speedup"] = round(
+            cold["first_fire_ms"] / max(warm["first_fire_ms"], 1e-9), 2)
+        rec["warmed_recompiles"] = warm["recompiles"]
+        rec["warmed_compile_storms"] = warm["compile_storms"]
+        rec["warmed_aot_hits"] = warm["aot_hits"]
+        rec["cold_programs_stored"] = cold["aot_stores"]
+        rec["ok"] = bool(rec["ok"]
+                         and warm["recompiles"] == 0
+                         and warm["compile_storms"] == 0
+                         and warm["aot_hits"] > 0
+                         and rec["first_fire_speedup"] >= 3.0)
+    else:
+        rec["ok"] = False
+    rounds = [int(m.group(1)) for f in glob.glob("COLDSTART_r*.json")
+              for m in [re.search(r"_r(\d+)\.json$", f)] if m]
+    path = f"COLDSTART_r{max(rounds, default=0) + 1:02d}.json"
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"path": path, **rec}))
+    sys.stdout.flush()
+
+
 def chaos(seed: int) -> None:
     """`python bench.py --chaos SEED`: the tiny Q5 stage with
     deterministic fault injection armed at every site (CHAOS_SPEC, seeded
@@ -1650,6 +1764,16 @@ if __name__ == "__main__":
         _multichip_worker(_n, _b, _s)
     elif "--multichip" in sys.argv:
         multichip()
+    elif "--coldstart-worker" in sys.argv:
+        i = sys.argv.index("--coldstart-worker")
+        _d = sys.argv[i + 1]
+        _b = (int(sys.argv[sys.argv.index("--batch") + 1])
+              if "--batch" in sys.argv else 1 << 12)
+        _nb = (int(sys.argv[sys.argv.index("--n-batches") + 1])
+               if "--n-batches" in sys.argv else 8)
+        _coldstart_worker(_d, _b, _nb)
+    elif "--coldstart" in sys.argv:
+        coldstart()
     elif "--suite" in sys.argv:
         suite()
     elif "--tiny" in sys.argv:

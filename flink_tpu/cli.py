@@ -122,11 +122,41 @@ def _cmd_checkpoint_verify(args) -> int:
                 os.path.join(args.dir, name)):
             rows.append([name, "QUARANTINED", "previously failed "
                                               "verification"])
+    # a co-located AOT executable cache (aot.dir pointed under the
+    # checkpoint root) is verified in the same sweep
+    aot_sub = os.path.join(args.dir, "aot")
+    if os.path.isdir(aot_sub):
+        from .runtime.aot import verify_aot_cache
+        for name, status, detail in verify_aot_cache(aot_sub):
+            rows.append([f"aot/{name}", status, detail])
+            if status == "CORRUPT":
+                worst = 1
     if not rows:
         print(f"no retained checkpoints under {args.dir}")
         return 2
     _print_table(["checkpoint", "status", "detail"], rows, max_rows=10_000)
     return worst
+
+
+def _cmd_aot_cache(args) -> int:
+    """Offline verification of a persistent AOT executable cache
+    directory (``aot.dir``): per-artifact OK/CORRUPT/QUARANTINED table
+    from the embedded header digests + environment fingerprint. Exit
+    code reflects the worst result — 0 all OK, 1 any CORRUPT, 2 nothing
+    to verify."""
+    import os
+
+    from .runtime.aot import verify_aot_cache
+
+    if not os.path.isdir(args.dir):
+        print(f"aot-cache: no such directory: {args.dir}", file=sys.stderr)
+        return 2
+    rows = [list(r) for r in verify_aot_cache(args.dir)]
+    if not rows:
+        print(f"no AOT artifacts under {args.dir}")
+        return 2
+    _print_table(["artifact", "status", "detail"], rows, max_rows=10_000)
+    return 1 if any(r[1] == "CORRUPT" for r in rows) else 0
 
 
 def _cmd_list(args) -> int:
@@ -743,6 +773,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     cvf.add_argument("dir", help="checkpoint storage directory "
                                  "(execution.checkpointing.dir)")
     cvf.set_defaults(fn=_cmd_checkpoint_verify)
+
+    aotc = sub.add_parser(
+        "aot-cache",
+        help="verify a persistent AOT executable cache directory "
+             "offline (artifact digests + environment fingerprint)")
+    aotc.add_argument("dir", help="the cache directory (config key "
+                                  "aot.dir)")
+    aotc.set_defaults(fn=_cmd_aot_cache)
 
     trd = sub.add_parser(
         "trace-dump",

@@ -522,9 +522,14 @@ class _Coordinator:
         few completed-checkpoint pointers (metadata only — the artifacts
         live in shared checkpoint storage)."""
         live = sorted(self._workers)
+        from ..core.config import AotOptions
         return {
             "epoch": self.epoch,
             "next_cid": self._next_cid,
+            # journaled next to the checkpoint pointers so a successor
+            # master can warm-start the AOT executable cache before it
+            # redeploys (compile-storm-free recovery)
+            "aot_dir": str(self.config.get(AotOptions.DIR) or ""),
             "restarts": self.restarts,
             "expected": sorted(self._expected),
             "slots": self.resources.slots_map(live),
@@ -983,6 +988,17 @@ class CoordinatorContender:
         coord.on_crash = self.kill  # coord.crash = full master death
         if journal:
             coord.adopt_journal(journal)
+            # the journal carries the AOT cache location next to the
+            # checkpoint pointers: warm the successor's executable cache
+            # now so post-takeover redeploys never trigger a compile storm
+            jdir = journal.get("aot_dir")
+            if jdir:
+                from ..core.config import AotOptions
+                self.config.set(AotOptions.ENABLED, True)
+                self.config.set(AotOptions.DIR, jdir)
+            from ..runtime.aot import AOT
+            AOT.configure(self.config)
+            AOT.warmup()
         addr = f"127.0.0.1:{coord.port}"
         if not self.ha.publish_leader_record(token, addr, self.owner):
             # a successor was elected past us (we stalled between the
@@ -1190,6 +1206,12 @@ class DistributedHost:
         from .isolation import ISOLATION
         ISOLATION.configure(config)
         ISOLATION.register_job(jg.name)
+        # compile-storm-free recovery: pre-load persisted AOT executables
+        # before any subtask builds a program, so a freshly (re)started
+        # worker process serves warm programs instead of recompiling
+        from ..runtime.aot import AOT
+        AOT.configure(config)
+        AOT.warmup()
         if any(e.feedback for e in jg.edges):
             raise NotImplementedError(
                 "iterations (feedback edges) run on the local deployment "

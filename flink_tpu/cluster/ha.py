@@ -409,6 +409,27 @@ class FileHaServices:
                                       f"{job_id}.pkl"))
         return rec["checkpoint"] if rec else None
 
+    # -- AOT executable-cache pointer --------------------------------------
+    # Recorded next to the checkpoint pointer so a successor master can
+    # warm-start the persistent AOT cache BEFORE it redeploys (compile-
+    # storm-free recovery). Never fenced: the location is immutable job
+    # config, not attempt state, so a late write cannot mislead anyone.
+    def put_aot_dir(self, job_id: str, directory: str) -> None:
+        try:
+            _atomic_write(
+                os.path.join(self.dir, "checkpoints", f"{job_id}.aot.json"),
+                json.dumps({"aot_dir": directory}).encode())
+        except OSError:
+            pass
+
+    def get_aot_dir(self, job_id: str) -> str:
+        try:
+            with open(os.path.join(self.dir, "checkpoints",
+                                   f"{job_id}.aot.json")) as f:
+                return str(json.loads(f.read()).get("aot_dir") or "")
+        except (OSError, ValueError):
+            return ""
+
     # -- job results -------------------------------------------------------
     def put_result(self, job_id: str, token: int, result: dict) -> bool:
         path = os.path.join(self.dir, "results", f"{job_id}.pkl")
@@ -523,8 +544,13 @@ class HaJobSupervisor:
 
     def submit(self, job_graph: Any) -> None:
         """Persist the job graph so any leader can recover it (reference
-        JobGraphStore.putJobGraph)."""
+        JobGraphStore.putJobGraph) — plus the AOT cache location, so a
+        successor warms compiled executables before it redeploys."""
         self.ha.put_job_graph(self.job_id, job_graph)
+        from ..core.config import AotOptions
+        aot_dir = str(self.config.get(AotOptions.DIR) or "")
+        if aot_dir:
+            self.ha.put_aot_dir(self.job_id, aot_dir)
 
     def kill(self) -> None:
         """Simulate master death: stop renewing the lease and abandon the
@@ -615,6 +641,17 @@ class HaJobSupervisor:
                     raise RuntimeError(f"job {self.job_id} not in HA store")
                 restore = self._verified_restore(
                     self.ha.get_checkpoint(self.job_id))
+                # compile-storm-free recovery: warm the AOT executable
+                # cache (location recorded next to the checkpoint pointer)
+                # before redeploying, so takeover never recompiles
+                from ..core.config import AotOptions
+                from ..runtime.aot import AOT
+                jdir = self.ha.get_aot_dir(self.job_id)
+                if jdir and not str(self.config.get(AotOptions.DIR) or ""):
+                    self.config.set(AotOptions.ENABLED, True)
+                    self.config.set(AotOptions.DIR, jdir)
+                AOT.configure(self.config)
+                AOT.warmup()
                 self.supervisor = JobSupervisor(jg, self.config)
                 orig_deploy = self.supervisor._deploy
 

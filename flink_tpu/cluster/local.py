@@ -204,6 +204,12 @@ def deploy_local(job_graph: JobGraph, config: Configuration,
     from .isolation import ISOLATION
     ISOLATION.configure(config)
     ISOLATION.register_job(job_graph.name)
+    # persistent AOT executable cache: warm-start this process's program
+    # caches (watchdog-bounded aot.warmup) before the first batch, so a
+    # restart/replacement pays zero compile storm (off by default)
+    from ..runtime.aot import AOT
+    AOT.configure(config)
+    AOT.warmup()
     if metrics_registry is not None:
         # process-global compile/transfer accounting surfaces through the
         # same registry the reporters/REST endpoint scrape
@@ -347,6 +353,13 @@ def live_rescale(job: "LocalJob", n_devices: int,
           .set_attribute("operators", len(targets))
           .set_attribute("new_devices", int(n_devices)))
     try:
+        # rescale-up warm start: programs for the NEW mesh shape compile
+        # on the first post-switch batch unless their executables are
+        # already warm — re-scan the persistent AOT cache (artifacts a
+        # prior run at the target scale stored) before the barrier
+        from ..runtime.aot import AOT
+        if AOT.enabled:
+            AOT.warmup()
         old_epochs = {tid: op._rescale_epoch for tid, op in targets}
         for _, op in targets:
             op.request_rescale(n_devices)

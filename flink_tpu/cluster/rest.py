@@ -147,6 +147,11 @@ class RestEndpoint:
         # operator diagnosing a flapping partition sees them here
         from .transport import NET_EVENTS
         entries.extend(self._job_scoped(NET_EVENTS, name))
+        # AOT executable-cache degradations (corrupt artifacts quarantined,
+        # version skew, store/load fallbacks): every silent fall-back to
+        # live compilation stays visible to the operator here
+        from ..runtime.aot import AOT
+        entries.extend(self._job_scoped(AOT.events, name))
         entries.sort(key=lambda e: e.get("timestamp") or 0, reverse=True)
         return {"name": name, "entries": entries}
 

@@ -19,6 +19,7 @@ this module exists to watch.
 
 from __future__ import annotations
 
+import collections
 import functools
 import threading
 import time
@@ -108,6 +109,21 @@ class DeviceStats:
         self._leader_elections: dict[str, int] = {}
         self._failovers: dict[str, int] = {}
         self._takeover_ms: list[float] = []
+        # AOT executable cache accounting (PR 19): persistent-cache hits
+        # and misses per scope, executables persisted, dispatch-time
+        # fallbacks from a loaded executable to the live jit path,
+        # in-memory program-cache LRU evictions, live XLA compiles paid
+        # while the persistent cache was active (the compile storm a
+        # warmed process must not see), and the process cold-start clock:
+        # configure-time mark -> first fired window (d2h fire)
+        self._aot_hits: dict[str, int] = {}
+        self._aot_misses: dict[str, int] = {}
+        self._aot_stores: dict[str, int] = {}
+        self._aot_fallbacks: dict[str, int] = {}
+        self._aot_evictions = 0
+        self._compile_storms: dict[str, int] = {}
+        self._cold_start_ms: list[float] = []
+        self._cold_start_t0: Optional[float] = None
         self._tracer = None  # optional Tracer receiving device spans
 
     # -- compile accounting ------------------------------------------------
@@ -131,6 +147,43 @@ class DeviceStats:
                 sb.set_start_ts(start_ms)
             sb.finish()
 
+    # -- AOT executable-cache accounting -------------------------------------
+    def note_aot_hit(self, scope: str) -> None:
+        with self._lock:
+            self._aot_hits[scope] = self._aot_hits.get(scope, 0) + 1
+
+    def note_aot_miss(self, scope: str) -> None:
+        with self._lock:
+            self._aot_misses[scope] = self._aot_misses.get(scope, 0) + 1
+
+    def note_aot_store(self, scope: str) -> None:
+        with self._lock:
+            self._aot_stores[scope] = self._aot_stores.get(scope, 0) + 1
+
+    def note_aot_fallback(self, scope: str) -> None:
+        with self._lock:
+            self._aot_fallbacks[scope] = self._aot_fallbacks.get(scope, 0) + 1
+
+    def note_aot_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self._aot_evictions += int(n)
+
+    def note_compile_storm(self, scope: str) -> None:
+        """A live XLA compile paid while the persistent AOT cache was
+        active — zero on a properly warmed process is the recovery
+        contract."""
+        with self._lock:
+            self._compile_storms[scope] = \
+                self._compile_storms.get(scope, 0) + 1
+
+    def mark_cold_start(self) -> None:
+        """Start the cold-start clock (idempotent until the first fired
+        window records it): called when an AOT-enabled deploy configures
+        this process."""
+        with self._lock:
+            if self._cold_start_t0 is None and not self._cold_start_ms:
+                self._cold_start_t0 = time.perf_counter()
+
     # -- transfer accounting -----------------------------------------------
     def note_h2d(self, nbytes: int, records: int = 0,
                  ms: Optional[float] = None) -> None:
@@ -150,6 +203,14 @@ class DeviceStats:
             self.d2h_bytes += int(nbytes)
             self.d2h_records += int(records)
             self.d2h_fires += 1
+            if self._cold_start_t0 is not None:
+                # first materialized result since the AOT-enabled deploy
+                # marked this process cold: the time-to-first-fired-window
+                # sample the coldstart bench compares warm vs cold
+                self._cold_start_ms.append(
+                    (time.perf_counter() - self._cold_start_t0) * 1e3)
+                del self._cold_start_ms[:-256]
+                self._cold_start_t0 = None
             tracer = self._tracer
         if tracer is not None:
             self._finish_transfer(tracer.span("device", "D2H"),
@@ -424,6 +485,36 @@ class DeviceStats:
         with self._lock:
             return sum(self._injected.values())
 
+    @property
+    def aot_hits(self) -> int:
+        with self._lock:
+            return sum(self._aot_hits.values())
+
+    @property
+    def aot_misses(self) -> int:
+        with self._lock:
+            return sum(self._aot_misses.values())
+
+    @property
+    def aot_stores(self) -> int:
+        with self._lock:
+            return sum(self._aot_stores.values())
+
+    @property
+    def aot_fallbacks(self) -> int:
+        with self._lock:
+            return sum(self._aot_fallbacks.values())
+
+    @property
+    def aot_in_memory_evictions(self) -> int:
+        with self._lock:
+            return self._aot_evictions
+
+    @property
+    def compile_storms(self) -> int:
+        with self._lock:
+            return sum(self._compile_storms.values())
+
     # -- views -------------------------------------------------------------
     @property
     def compiles(self) -> int:
@@ -499,6 +590,19 @@ class DeviceStats:
                 round(tk[len(tk) // 2], 3) if tk else 0.0)
             out["takeover_duration_ms_max"] = (
                 round(tk[-1], 3) if tk else 0.0)
+            out["aot_hits_total"] = sum(self._aot_hits.values())
+            out["aot_misses_total"] = sum(self._aot_misses.values())
+            out["aot_stores_total"] = sum(self._aot_stores.values())
+            out["aot_fallbacks_total"] = sum(self._aot_fallbacks.values())
+            out["aot_in_memory_evictions_total"] = self._aot_evictions
+            out["compile_storms_total"] = \
+                sum(self._compile_storms.values())
+            cs = sorted(self._cold_start_ms)
+            out["cold_start_ms_count"] = len(cs)
+            out["cold_start_ms_p50"] = (
+                round(cs[len(cs) // 2], 3) if cs else 0.0)
+            out["cold_start_ms_max"] = (
+                round(cs[-1], 3) if cs else 0.0)
             for scope, n in sorted(self._compiles.items()):
                 out[f"compiles.{scope}"] = n
             for scope, n in sorted(self._retries.items()):
@@ -527,6 +631,12 @@ class DeviceStats:
                 out[f"leader_elections.{scope}"] = n
             for mode, n in sorted(self._failovers.items()):
                 out[f"coordinator_failovers.{mode}"] = n
+            for scope, n in sorted(self._aot_hits.items()):
+                out[f"aot_hits.{scope}"] = n
+            for scope, n in sorted(self._aot_fallbacks.items()):
+                out[f"aot_fallbacks.{scope}"] = n
+            for scope, n in sorted(self._compile_storms.items()):
+                out[f"compile_storms.{scope}"] = n
             return out
 
     def reset(self) -> None:
@@ -550,6 +660,14 @@ class DeviceStats:
             self._leader_elections.clear()
             self._failovers.clear()
             self._takeover_ms.clear()
+            self._aot_hits.clear()
+            self._aot_misses.clear()
+            self._aot_stores.clear()
+            self._aot_fallbacks.clear()
+            self._aot_evictions = 0
+            self._compile_storms.clear()
+            self._cold_start_ms.clear()
+            self._cold_start_t0 = None
             self._spans_dropped = 0
             self._panes_sealed = 0
             self._batches_coalesced = 0
@@ -657,17 +775,34 @@ def _record_program_audit(scope, fn, args, kwargs, build_key) -> None:
 class _TimedProgram:
     """Times the FIRST dispatch of a freshly-built program — jax.jit
     traces/lowers/compiles synchronously inside that call, so its wall
-    clock IS the compile cost; later calls pay one extra branch."""
+    clock IS the compile cost; later calls pay one extra branch.
 
-    __slots__ = ("_fn", "_scope", "_compiled", "_build_key")
+    When the persistent AOT cache is active, dispatches route through an
+    explicitly-compiled executable per call signature: a warm-loaded one
+    (no compile at all) or a live ``lower().compile()`` whose result is
+    persisted for the next cold process. Any failure on that path falls
+    back to the plain jit call — the cache never fails a dispatch."""
 
-    def __init__(self, fn, scope: str, build_key: str = ""):
+    __slots__ = ("_fn", "_scope", "_compiled", "_build_key",
+                 "_build_counted", "_aot_execs", "_aot_bad")
+
+    def __init__(self, fn, scope: str, build_key: str = "",
+                 build_counted: bool = True):
         self._fn = fn
         self._scope = scope
         self._compiled = False
         self._build_key = build_key
+        self._build_counted = build_counted
+        self._aot_execs = None  # call_sig -> compiled executable
+        self._aot_bad = None    # call_sigs pinned to the plain jit path
 
     def __call__(self, *args, **kwargs):
+        from ..runtime.aot import AOT
+        if AOT.dispatch_active():
+            return self._call_aot(AOT, args, kwargs)
+        return self._call_plain(args, kwargs)
+
+    def _call_plain(self, args, kwargs):
         if self._compiled:
             if not DEVICE_LEDGER.enabled:
                 return self._fn(*args, **kwargs)
@@ -681,8 +816,17 @@ class _TimedProgram:
         start_ms = now_ms()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
+        self._note_live_compile((time.perf_counter() - t0) * 1e3,
+                                start_ms, args, kwargs)
+        return out
+
+    def _note_live_compile(self, ms, start_ms, args, kwargs) -> None:
         self._compiled = True
-        ms = (time.perf_counter() - t0) * 1e3
+        if not self._build_counted:
+            # the builder skipped compile accounting expecting a warm
+            # executable; this dispatch compiled after all, so it counts
+            self._build_counted = True
+            DEVICE_STATS.note_build(self._scope)
         DEVICE_STATS.note_compile_done(self._scope, ms, start_ms)
         # first dispatch = trace/lower/compile: charged to the ledger as
         # compile time, never as a steady-state dispatch sample
@@ -690,7 +834,82 @@ class _TimedProgram:
                              kind="compile")
         _record_program_audit(self._scope, self._fn, args, kwargs,
                               self._build_key)
+
+    def _call_aot(self, aot, args, kwargs):
+        sig = aot.call_signature(args, kwargs)
+        lower = getattr(self._fn, "lower", None)
+        if sig is None or lower is None or \
+                (self._aot_bad and sig in self._aot_bad):
+            # not an AOT-able dispatch (non-array leaves, a plain python
+            # builder, or a signature already pinned to the jit path)
+            return self._call_plain(args, kwargs)
+        execs = self._aot_execs
+        if execs is None:
+            execs = self._aot_execs = {}
+        compiled = execs.get(sig)
+        fresh = False
+        if compiled is None:
+            compiled = aot.lookup(self._scope, self._build_key, sig)
+            if compiled is not None:
+                # warm hit: the executable was pre-loaded by warmup — no
+                # compile happens, no compile is counted
+                execs[sig] = compiled
+                self._compiled = True
+            else:
+                # persistent-cache miss while the cache is active: pay
+                # the live compile (the compile storm a warmed process
+                # must not see) and persist the result for the next one
+                from .tracing import now_ms
+                start_ms = now_ms()
+                t0 = time.perf_counter()
+                try:
+                    compiled = lower(*args, **kwargs).compile()
+                except Exception:  # noqa: BLE001 - degrade to jit
+                    self._pin_bad(sig)
+                    return self._call_plain(args, kwargs)
+                execs[sig] = compiled
+                fresh = True
+                ms = (time.perf_counter() - t0) * 1e3
+                DEVICE_STATS.note_compile_storm(self._scope)
+                if not self._compiled:
+                    self._note_live_compile(ms, start_ms, args, kwargs)
+                else:
+                    # an additional specialization of an already-compiled
+                    # program: still compile time, never a dispatch sample
+                    DEVICE_STATS.note_compile_done(self._scope, ms,
+                                                   start_ms)
+                    DEVICE_LEDGER.record(self._scope, ms,
+                                         shape_sig=self._build_key,
+                                         kind="compile")
+        try:
+            if not DEVICE_LEDGER.enabled:
+                out = compiled(*args, **kwargs)
+            else:
+                t0 = time.perf_counter()
+                out = compiled(*args, **kwargs)
+                DEVICE_LEDGER.record(self._scope,
+                                     (time.perf_counter() - t0) * 1e3,
+                                     shape_sig=self._build_key)
+        except Exception as e:  # noqa: BLE001 - degrade to jit
+            execs.pop(sig, None)
+            self._pin_bad(sig)
+            aot.note_dispatch_fallback(self._scope, e)
+            return self._call_plain(args, kwargs)
+        if fresh:
+            aot.store(self._scope, self._build_key, sig, compiled)
         return out
+
+    def _pin_bad(self, sig) -> None:
+        if self._aot_bad is None:
+            self._aot_bad = set()
+        self._aot_bad.add(sig)
+
+
+#: ``functools.lru_cache``-compatible statistics tuple, preserved so the
+#: ``wrapper.cache_info()`` API survives the switch to the config-capped
+#: LRU below.
+_CacheInfo = collections.namedtuple(
+    "CacheInfo", ["hits", "misses", "maxsize", "currsize"])
 
 
 def instrumented_program_cache(scope: str, maxsize: int = 128):
@@ -698,11 +917,32 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
     program BUILDER: a cache miss counts one compile (the returned
     program's first dispatch is timed as its compile span); a hit counts
     one cache hit. The cached object is shared exactly as before, so
-    donation/in-place semantics of the jitted programs are untouched."""
+    donation/in-place semantics of the jitted programs are untouched.
+
+    The cache is a config-capped LRU (``aot.in-memory-max-programs``;
+    0 = unbounded): evictions count into
+    ``aot_in_memory_evictions_total``, and an evicted program rebuilt
+    while its executable is warm in the persistent AOT cache skips the
+    compile counters entirely — eviction + AOT reload is never a
+    recompile. A miss while a warm executable exists likewise bypasses
+    the compile accounting, the recompile-attribution ledger, and the
+    ``device.compile`` fault/watchdog sites: building the lazy jit
+    wrapper is not a compile."""
 
     def deco(builder: Callable):
-        @functools.lru_cache(maxsize=maxsize)
-        def build(*args, **kwargs):
+        lock = threading.Lock()
+        cache = collections.OrderedDict()
+        stats = {"hits": 0, "misses": 0}
+
+        def _build_program(args, kwargs):
+            key = repr((args, tuple(sorted(kwargs.items()))))
+            from ..runtime.aot import AOT
+            if AOT.has_program(scope, key):
+                # warm start: executables for this program were
+                # pre-loaded from the persistent cache, so no compile is
+                # decided here — the dispatch path serves them directly
+                return _TimedProgram(builder(*args, **kwargs), scope,
+                                     build_key=key, build_counted=False)
             # the device.compile fault site + watchdog deadline cover
             # EVERY instrumented builder (device_window/device_session/
             # device_group_agg/pallas_topk/tpu_backend) at the one place
@@ -715,7 +955,6 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
                 from ..runtime.faults import fire_with_retries
                 fire_with_retries("device.compile", scope=scope)
                 DEVICE_STATS.note_build(scope)
-                key = repr((args, tuple(sorted(kwargs.items()))))
                 # recompile attribution only — the ledger never touches
                 # DEVICE_STATS.compiles (the bench recompile budget)
                 DEVICE_LEDGER.note_build(scope, key, builder, args,
@@ -725,16 +964,49 @@ def instrumented_program_cache(scope: str, maxsize: int = 128):
 
             return WATCHDOG.run("device.compile", _build, scope=scope)
 
+        def _cap() -> int:
+            from ..runtime.aot import AOT
+            return AOT.in_memory_max_programs
+
         @functools.wraps(builder)
         def wrapper(*args, **kwargs):
-            misses = build.cache_info().misses
-            prog = build(*args, **kwargs)
-            if build.cache_info().misses == misses:
+            ck = (args, tuple(sorted(kwargs.items())))
+            with lock:
+                prog = cache.get(ck)
+                if prog is not None:
+                    cache.move_to_end(ck)
+                    stats["hits"] += 1
+            if prog is not None:
                 DEVICE_STATS.note_cache_hit(scope)
+                return prog
+            # build outside the lock: compiles are slow and must not
+            # serialize unrelated builders' cache hits
+            prog = _build_program(args, kwargs)
+            evicted = 0
+            with lock:
+                prog = cache.setdefault(ck, prog)
+                cache.move_to_end(ck)
+                stats["misses"] += 1
+                cap = _cap()
+                while cap and len(cache) > cap:
+                    cache.popitem(last=False)
+                    evicted += 1
+            if evicted:
+                DEVICE_STATS.note_aot_eviction(evicted)
             return prog
 
-        wrapper.cache_clear = build.cache_clear
-        wrapper.cache_info = build.cache_info
+        def cache_info() -> _CacheInfo:
+            with lock:
+                return _CacheInfo(stats["hits"], stats["misses"],
+                                  _cap() or None, len(cache))
+
+        def cache_clear() -> None:
+            with lock:
+                cache.clear()
+                stats["hits"] = stats["misses"] = 0
+
+        wrapper.cache_clear = cache_clear
+        wrapper.cache_info = cache_info
         return wrapper
 
     return deco
@@ -785,6 +1057,19 @@ def bind_device_metrics(registry) -> None:
     # flink_tpu_device_coordinator_failovers_total)
     g.gauge("leader_elections_total", lambda: s.leader_elections)
     g.gauge("coordinator_failovers_total", lambda: s.coordinator_failovers)
+    # AOT executable cache (prometheus: flink_tpu_device_aot_hits_total /
+    # flink_tpu_device_aot_misses_total /
+    # flink_tpu_device_aot_stores_total /
+    # flink_tpu_device_aot_fallbacks_total /
+    # flink_tpu_device_aot_in_memory_evictions_total /
+    # flink_tpu_device_compile_storms_total)
+    g.gauge("aot_hits_total", lambda: s.aot_hits)
+    g.gauge("aot_misses_total", lambda: s.aot_misses)
+    g.gauge("aot_stores_total", lambda: s.aot_stores)
+    g.gauge("aot_fallbacks_total", lambda: s.aot_fallbacks)
+    g.gauge("aot_in_memory_evictions_total",
+            lambda: s.aot_in_memory_evictions)
+    g.gauge("compile_storms_total", lambda: s.compile_storms)
     # tracing (prometheus: flink_tpu_device_spans_dropped_total)
     g.gauge("spans_dropped_total", lambda: s.spans_dropped)
     # incremental fire engine / coalesced ingest (prometheus:

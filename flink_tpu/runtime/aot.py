@@ -1,0 +1,501 @@
+"""Persistent verified AOT executable cache: compile-storm-free recovery.
+
+Coordinator failover, live rescale, and plain process restarts all
+restore *state* quickly, but a cold worker still pays full XLA
+compilation for every program before it serves its first batch — recovery
+time is dominated by an unbounded compile storm. Compiled executables are
+state too: JAX's AOT path (``fn.lower(...).compile()`` +
+``jax.experimental.serialize_executable``) lets us checkpoint them the
+same way checkpoint storage persists key-group chunks.
+
+Artifact contract (the PR-4 manifest machinery applied per artifact):
+
+* one file per ``(scope, build-key, call-signature)`` — content-addressed
+  name ``blake2b16(scope, build_key, call_sig, fingerprint).aotx``;
+* a JSON header line (format tag, scope, build key, call signature,
+  environment fingerprint, payload size + blake2b digest) followed by the
+  pickled ``serialize_executable.serialize`` tuple;
+* committed write-tmp/fsync/rename; a digest/size/format mismatch raises
+  the same typed :class:`CorruptArtifactError` the checkpoint verifier
+  uses, and the artifact is quarantined as ``<name>.corrupt``;
+* the environment fingerprint (jax/jaxlib version, backend platform,
+  device kind, x64 flag) discriminates artifacts so a stale executable is
+  never deserialized onto the wrong target — skew is a cache miss, never
+  an error.
+
+Degradation ladder: every failure on this path — missing capability
+(older jaxlib without ``serialize_executable``), corrupt or truncated or
+version-skewed artifacts, injected ``aot.load`` / ``aot.store`` faults,
+a stalled ``aot.warmup`` scan — degrades to live compilation. The cache
+can only ever make a process faster, never fail a job.
+
+Warm start: every cold-process path (``deploy_local``,
+``DistributedHost`` deploy, rescale-up replicas, post-failover
+successors) calls :meth:`AotRuntime.warmup`, which pre-deserializes every
+fingerprint-matching artifact under a watchdog-bounded ``aot.warmup``
+deadline before the first batch. A warmed program's builder skips the
+compile counters entirely (``recompiles == 0`` is the contract the
+failover × warm-start drills assert).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Optional
+
+from ..checkpoint.storage import (CorruptArtifactError, _fsync_write,
+                                  _payload_digest)
+
+__all__ = ["AotRuntime", "AOT", "AOT_FORMAT", "environment_fingerprint",
+           "verify_aot_cache"]
+
+#: Format tag every artifact header carries; bumped on layout changes so
+#: an old cache directory reads as all-skew (miss), never as garbage.
+AOT_FORMAT = "flink-tpu-aot-v1"
+
+_SUFFIX = ".aotx"
+_EVENT_LIMIT = 512
+
+
+def _serialization_module():
+    """Capability probe: the AOT serialize/deserialize entry points, or
+    None on older jaxlib vintages (callers downgrade to compile-on-miss)."""
+    try:
+        from jax.experimental import serialize_executable as mod
+    except Exception:
+        return None
+    if not (hasattr(mod, "serialize") and hasattr(mod, "deserialize_and_load")):
+        return None
+    return mod
+
+
+def environment_fingerprint() -> list:
+    """Backend/version discriminator baked into every artifact: a stale
+    executable must never load onto the wrong target, so fingerprint
+    mismatch is treated as a plain cache miss."""
+    import jax
+    try:
+        jaxlib_version = str(jax.lib.__version__)
+    except Exception:
+        jaxlib_version = "unknown"
+    try:
+        dev = jax.devices()[0]
+        platform = str(getattr(dev, "platform", "unknown"))
+        device_kind = str(getattr(dev, "device_kind", platform))
+    except Exception:
+        platform = device_kind = "unknown"
+    x64 = bool(getattr(jax.config, "jax_enable_x64", False))
+    return [AOT_FORMAT, str(jax.__version__), jaxlib_version, platform,
+            device_kind, x64]
+
+
+def _artifact_name(scope: str, build_key: str, call_sig: str,
+                   fingerprint: list) -> str:
+    ident = repr((scope, build_key, call_sig, tuple(fingerprint)))
+    return hashlib.blake2b(ident.encode(), digest_size=16).hexdigest() + _SUFFIX
+
+
+def _parse_artifact(raw: bytes, path: str) -> tuple:
+    """Split + verify one artifact's header/payload; raises
+    CorruptArtifactError on any integrity problem (truncation, digest
+    mismatch, undecodable header, wrong format tag)."""
+    nl = raw.find(b"\n")
+    if nl < 0:
+        raise CorruptArtifactError(f"AOT artifact {path}: no header line")
+    try:
+        header = json.loads(raw[:nl].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CorruptArtifactError(
+            f"AOT artifact {path}: undecodable header ({e})") from e
+    if not isinstance(header, dict) or header.get("format") != AOT_FORMAT:
+        raise CorruptArtifactError(
+            f"AOT artifact {path}: format "
+            f"{header.get('format') if isinstance(header, dict) else header!r}"
+            f" != {AOT_FORMAT}")
+    payload = raw[nl + 1:]
+    if len(payload) != header.get("payload_size"):
+        raise CorruptArtifactError(
+            f"AOT artifact {path}: payload truncated "
+            f"({len(payload)} != {header.get('payload_size')} bytes)")
+    if _payload_digest(payload) != header.get("payload_digest"):
+        raise CorruptArtifactError(
+            f"AOT artifact {path}: payload digest mismatch")
+    return header, payload
+
+
+class AotRuntime:
+    """Process-global AOT executable cache (the ``FAULTS``/``WATCHDOG``
+    singleton pattern): deploy paths ``configure()`` it from the job
+    Configuration and ``warmup()`` it before the first batch; the
+    instrumented program cache consults it at build and dispatch time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.directory = ""
+        self.in_memory_max_programs = 0
+        #: (scope, build_key, call_sig) -> deserialized executable
+        self._loaded: dict[tuple, Any] = {}
+        #: (scope, build_key) prefixes with >=1 warm executable — the
+        #: build-time "skip the compile counters" check
+        self._programs: set[tuple] = set()
+        self.warmed = False
+        self._capable = False
+        self._capability_warned = False
+        #: bounded event log merged into REST /jobs/<name>/exceptions
+        self.events: list[dict] = []
+
+    # -- configuration ---------------------------------------------------
+    def configure(self, config) -> None:
+        """Adopt ``aot.*`` keys from a job Configuration. Marks the
+        process cold-start clock (``cold_start_ms``) the first time an
+        enabled cache is configured."""
+        from ..core.config import AotOptions
+
+        enabled = bool(config.get(AotOptions.ENABLED))
+        directory = str(config.get(AotOptions.DIR) or "")
+        cap = int(config.get(AotOptions.IN_MEMORY_MAX_PROGRAMS))
+        capable = _serialization_module() is not None
+        with self._lock:
+            changed = directory != self.directory
+            self.enabled = enabled and bool(directory)
+            self.directory = directory
+            self.in_memory_max_programs = max(cap, 0)
+            self._capable = capable
+            if changed:
+                self._loaded.clear()
+                self._programs.clear()
+                self.warmed = False
+        if self.enabled:
+            try:
+                os.makedirs(directory, exist_ok=True)
+            except OSError as e:
+                self._event("aot-dir-unusable", error=str(e))
+                with self._lock:
+                    self.enabled = False
+                return
+            if not capable:
+                self._warn_capability()
+            # The cache serves device-state programs exclusively, and that
+            # path runs under x64 (hash_table.ensure_x64 flips it lazily at
+            # first use). Adopt the regime now, BEFORE the warmup scan
+            # fingerprints the process — otherwise artifacts stored after
+            # the state path ran (x64 on) read as version skew to a warmup
+            # that scanned before it (x64 still off).
+            from ..ops.hash_table import ensure_x64
+            ensure_x64()
+            from ..metrics.device import DEVICE_STATS
+            DEVICE_STATS.mark_cold_start()
+
+    def reset(self) -> None:
+        """Disarm and clear all warm state (test isolation)."""
+        with self._lock:
+            self.enabled = False
+            self.directory = ""
+            self.in_memory_max_programs = 0
+            self._loaded.clear()
+            self._programs.clear()
+            self.warmed = False
+            self._capable = False
+            self._capability_warned = False
+            self.events.clear()
+
+    # -- capability ------------------------------------------------------
+    @property
+    def capable(self) -> bool:
+        return self._capable and _serialization_module() is not None
+
+    def dispatch_active(self) -> bool:
+        """True when dispatches should consult the persistent cache:
+        enabled, a directory is set, and the jaxlib vintage can
+        (de)serialize executables. One attribute read when disabled."""
+        return self.enabled and self._capable
+
+    def _warn_capability(self) -> None:
+        """A single warning event when serialization is unavailable —
+        the cache silently downgrades to compile-on-miss, never raises."""
+        with self._lock:
+            if self._capability_warned:
+                return
+            self._capability_warned = True
+        self._event(
+            "aot-capability-missing",
+            detail="jax.experimental.serialize_executable unavailable on "
+                   "this jax/jaxlib; AOT cache downgraded to "
+                   "compile-on-miss (no executables persisted or loaded)")
+
+    # -- events ----------------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        with self._lock:
+            if len(self.events) < _EVENT_LIMIT:
+                self.events.append(
+                    {"timestamp": time.time(), "kind": kind, **fields})
+
+    # -- lookups ---------------------------------------------------------
+    def has_program(self, scope: str, build_key: str) -> bool:
+        """True when warmup pre-loaded at least one executable for this
+        (scope, build-key) — the builder then skips the compile counters,
+        the recompile-attribution ledger, and the device.compile site."""
+        if not (self.enabled and self.warmed):
+            return False
+        with self._lock:
+            return (scope, build_key) in self._programs
+
+    def lookup(self, scope: str, build_key: str, call_sig: str):
+        """A warm executable for this exact dispatch signature, or None.
+        Counts one aot hit/miss per (program, signature)."""
+        with self._lock:
+            compiled = self._loaded.get((scope, build_key, call_sig))
+        from ..metrics.device import DEVICE_STATS
+        if compiled is not None:
+            DEVICE_STATS.note_aot_hit(scope)
+        else:
+            DEVICE_STATS.note_aot_miss(scope)
+        return compiled
+
+    def note_dispatch_fallback(self, scope: str, error: BaseException) -> None:
+        """A loaded executable failed to dispatch — degrade to the live
+        jit path for that signature, counted and surfaced."""
+        from ..metrics.device import DEVICE_STATS
+        DEVICE_STATS.note_aot_fallback(scope)
+        self._event("aot-dispatch-fallback", scope=scope, error=str(error))
+
+    @staticmethod
+    def call_signature(args: tuple, kwargs: dict) -> Optional[str]:
+        """Shape/dtype signature of one dispatch's arguments (the key
+        discriminating compiled specializations under one build key).
+        None when a leaf is neither an array nor a plain static value —
+        such dispatches just use the live jit path."""
+        try:
+            import jax
+            leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        except Exception:
+            return None
+        sig = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is not None and dtype is not None:
+                sig.append((tuple(shape), str(dtype)))
+            elif isinstance(leaf, (bool, int, float, str, bytes, type(None))):
+                sig.append(("static", repr(leaf)))
+            else:
+                return None
+        return repr((str(treedef), sig))
+
+    # -- store -----------------------------------------------------------
+    def store(self, scope: str, build_key: str, call_sig: str,
+              compiled) -> bool:
+        """Persist one freshly-compiled executable. Every failure —
+        serialization, an injected ``aot.store`` trip, an unwritable
+        directory — skips persistence and returns False; the in-process
+        program keeps serving. A poison trip commits a corrupt-mutated
+        payload (the ``checkpoint.corrupt`` analog) that the verified
+        load path must catch."""
+        if not self.dispatch_active():
+            return False
+        mod = _serialization_module()
+        if mod is None:
+            self._warn_capability()
+            return False
+        try:
+            payload = pickle.dumps(mod.serialize(compiled))
+        except Exception as e:  # noqa: BLE001 - any failure degrades
+            self._event("aot-serialize-failed", scope=scope, error=str(e))
+            return False
+        poison = False
+        from .faults import InjectedFault, fire_with_retries
+        try:
+            fire_with_retries("aot.store", scope=scope)
+        except InjectedFault as e:
+            if not e.poison:
+                self._event("aot-store-failed", scope=scope, error=str(e))
+                return False
+            poison = True
+        fingerprint = environment_fingerprint()
+        header = json.dumps({
+            "format": AOT_FORMAT, "scope": scope, "build_key": build_key,
+            "call_sig": call_sig, "fingerprint": fingerprint,
+            "payload_size": len(payload),
+            "payload_digest": _payload_digest(payload),
+        }, sort_keys=True).encode()
+        if poison and payload:
+            # digest was taken over the clean payload, so the committed
+            # artifact is corrupt-on-disk: the load path MUST detect it
+            mutated = bytearray(payload)
+            mutated[len(mutated) // 2] ^= 0x40
+            payload = bytes(mutated)
+        name = _artifact_name(scope, build_key, call_sig, fingerprint)
+        try:
+            _fsync_write(os.path.join(self.directory, name),
+                         header + b"\n" + payload)
+        except OSError as e:
+            self._event("aot-store-failed", scope=scope, error=str(e))
+            return False
+        with self._lock:
+            # keep the (clean, in-memory) executable registered so an
+            # LRU-evicted builder-cache entry rebuilt later finds it warm
+            # — eviction + AOT reload is never a recompile
+            self._loaded[(scope, build_key, call_sig)] = compiled
+            self._programs.add((scope, build_key))
+        from ..metrics.device import DEVICE_STATS
+        DEVICE_STATS.note_aot_store(scope)
+        return True
+
+    # -- warm start ------------------------------------------------------
+    def warmup(self) -> int:
+        """Pre-deserialize every fingerprint-matching artifact in the
+        cache directory under the watchdog-bounded ``aot.warmup``
+        deadline. Returns the number of executables loaded; degrades on
+        stall/corruption/capability gaps (partial loads stay usable),
+        never raises."""
+        if not (self.enabled and self.directory):
+            return 0
+        if _serialization_module() is None:
+            self._warn_capability()
+            with self._lock:
+                self.warmed = True
+            return 0
+        from .watchdog import WATCHDOG, StallError
+        loaded = 0
+        try:
+            loaded = WATCHDOG.run("aot.warmup", self._warmup_scan,
+                                  scope="aot")
+        except StallError as e:
+            # the scan registers executables as it goes, so whatever it
+            # loaded before the deadline still serves; the rest miss
+            self._event("aot-warmup-stalled", error=str(e))
+            with self._lock:
+                loaded = len(self._loaded)
+        with self._lock:
+            self.warmed = True
+        return loaded
+
+    def _warmup_scan(self) -> int:
+        mod = _serialization_module()
+        fingerprint = environment_fingerprint()
+        loaded = 0
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return 0
+        from .faults import InjectedFault
+        for name in names:
+            if not name.endswith(_SUFFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                header, payload = self._read_artifact(path)
+            except InjectedFault as e:
+                from ..metrics.device import DEVICE_STATS
+                DEVICE_STATS.note_aot_fallback("warmup")
+                self._event("aot-load-failed", artifact=name, error=str(e))
+                continue
+            except CorruptArtifactError as e:
+                self._quarantine(path, str(e))
+                continue
+            except OSError as e:
+                self._event("aot-load-failed", artifact=name, error=str(e))
+                continue
+            if header.get("fingerprint") != fingerprint:
+                # version/backend skew: a miss, never an error
+                self._event("aot-version-skew", artifact=name,
+                            artifact_fingerprint=header.get("fingerprint"),
+                            process_fingerprint=fingerprint)
+                continue
+            key = (header["scope"], header["build_key"], header["call_sig"])
+            with self._lock:
+                if key in self._loaded:
+                    continue  # re-scan (rescale/takeover): already warm
+            try:
+                compiled = mod.deserialize_and_load(*pickle.loads(payload))
+            except Exception as e:  # noqa: BLE001 - artifact unusable
+                self._quarantine(path, f"undeserializable payload: {e}")
+                continue
+            with self._lock:
+                self._loaded[key] = compiled
+                self._programs.add(key[:2])
+            loaded += 1
+        return loaded
+
+    def _read_artifact(self, path: str) -> tuple:
+        """Read + verify one artifact under the ``aot.load`` fault site.
+        A poison trip mutates the payload before verification (the
+        corrupt-mutation flavor), so the digest check — not luck — is
+        what catches it."""
+        from .faults import InjectedFault, fire_with_retries
+        poison = False
+        try:
+            fire_with_retries("aot.load", scope="aot")
+        except InjectedFault as e:
+            if not e.poison:
+                raise
+            poison = True
+        with open(path, "rb") as f:
+            raw = f.read()
+        if poison and raw:
+            mutated = bytearray(raw)
+            mutated[len(mutated) // 2] ^= 0x40
+            raw = bytes(mutated)
+        return _parse_artifact(raw, path)
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        """Corrupt artifact: rename to ``<name>.corrupt`` so it never
+        sits in the warmup scan again, count + flight-record it."""
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        self._event("aot-corrupt-artifact",
+                    artifact=os.path.basename(path), error=reason)
+        from ..metrics.device import DEVICE_STATS
+        DEVICE_STATS.note_verify_failure("aot.artifact")
+
+    # -- views -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled, "directory": self.directory,
+                    "capable": self._capable, "warmed": self.warmed,
+                    "loaded_executables": len(self._loaded),
+                    "loaded_programs": len(self._programs)}
+
+
+#: The process-global AOT cache every instrumented program consults.
+#: ``deploy_local`` / ``DistributedHost.deploy`` / bench configure and
+#: warm it from the job Configuration.
+AOT = AotRuntime()
+
+
+def verify_aot_cache(directory: str) -> list:
+    """Offline artifact verification for the CLI: ``(artifact, status,
+    detail)`` rows — OK (header + digest verify), CORRUPT (any integrity
+    failure), QUARANTINED (``*.corrupt`` left by a prior run)."""
+    rows = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as e:
+        return [(directory, "CORRUPT", f"unreadable directory: {e}")]
+    for name in names:
+        path = os.path.join(directory, name)
+        if name.endswith(".corrupt"):
+            rows.append((name, "QUARANTINED", "quarantined by a prior run"))
+            continue
+        if not name.endswith(_SUFFIX):
+            continue
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            header, _payload = _parse_artifact(raw, path)
+        except (CorruptArtifactError, OSError) as e:
+            rows.append((name, "CORRUPT", str(e)))
+            continue
+        fp = header.get("fingerprint") or []
+        rows.append((name, "OK",
+                     f"scope={header.get('scope')} "
+                     f"jax={fp[1] if len(fp) > 1 else '?'}"))
+    return rows

@@ -48,6 +48,14 @@ Sites (see docs/ROBUSTNESS.md for where each is threaded):
     ha.lease          a leader-lease renew or steal attempt fails (or,
                       with !hang@MS, stalls — the GC-pause analog that
                       lets the lease expire under a live leader)
+    aot.load          reading a persisted AOT executable artifact back
+                      (warm-start scan); a !poison trip corrupt-mutates
+                      the read bytes so digest verification — not luck —
+                      must catch it (the checkpoint.corrupt analog)
+    aot.store         persisting a freshly-compiled executable; a trip
+                      skips persistence (compile-on-miss next process),
+                      a !poison trip commits a corrupt-mutated artifact
+                      for the verified load path to quarantine
 
 Every rule also accepts a ``!hang@MS`` flag: the trip SLEEPS MS
 milliseconds at the site instead of raising — the deterministic stand-in
@@ -96,6 +104,7 @@ FAULT_SITES = (
     "net.connect", "net.sever", "net.delay", "net.zombie",
     "sched.admit", "sched.shed",
     "coord.crash", "ha.lease",
+    "aot.load", "aot.store",
 )
 
 

@@ -118,6 +118,7 @@ class Watchdog:
         "tier.evict": "TIER_TIMEOUT",
         "tier.prefetch": "TIER_TIMEOUT",
         "bench.probe": "PROBE_TIMEOUT",
+        "aot.warmup": "AOT_WARMUP_TIMEOUT",  # lint: key-ok watchdog site label, not a config key
     }
 
     #: sites whose deadline reads from NetworkOptions instead (net.*

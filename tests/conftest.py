@@ -44,7 +44,8 @@ def _stall_wall_clock_guard(request):
     if (request.node.get_closest_marker("stall") is None
             and request.node.get_closest_marker("netfault") is None
             and request.node.get_closest_marker("isolation") is None
-            and request.node.get_closest_marker("failover") is None):
+            and request.node.get_closest_marker("failover") is None
+            and request.node.get_closest_marker("aot") is None):
         yield
         return
     import signal

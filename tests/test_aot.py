@@ -1,0 +1,600 @@
+"""Compile-storm-free recovery drills (docs/ROBUSTNESS.md 'Compile-storm-
+free recovery'): the persistent verified AOT executable cache. Artifact
+roundtrip across a simulated process restart (zero recompiles, byte-
+identical output), corruption/truncation/version-skew degradation (always
+fall back to live compilation, never fail), capability downgrade on older
+jaxlib, the config-capped in-memory LRU (eviction + AOT reload is never a
+recompile), ``aot.load`` / ``aot.store`` chaos including the poison
+corrupt-mutation flavors, the HA journal pointer successors warm from,
+the CLI verifier, and the REST exception surface."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from flink_tpu.core.config import (  # noqa: E402
+    AotOptions, Configuration, FaultOptions, PipelineOptions,
+)
+from flink_tpu.metrics.device import (  # noqa: E402
+    DEVICE_STATS, instrumented_program_cache,
+)
+from flink_tpu.runtime import faults as faults_mod  # noqa: E402
+from flink_tpu.runtime.aot import (  # noqa: E402
+    AOT, AOT_FORMAT, environment_fingerprint, verify_aot_cache,
+)
+from flink_tpu.runtime.faults import FAULT_SITES, FaultRule  # noqa: E402
+from flink_tpu.runtime.watchdog import WATCHDOG  # noqa: E402
+
+pytestmark = pytest.mark.aot
+
+
+@pytest.fixture(autouse=True)
+def _clean_runtime():
+    AOT.reset()
+    faults_mod.FAULTS.reset()
+    WATCHDOG.reset()
+    yield
+    AOT.reset()
+    faults_mod.FAULTS.reset()
+    WATCHDOG.reset()
+
+
+def _cfg(directory, cap: int = 0, faults_spec: str = "",
+         seed: int = 0) -> Configuration:
+    cfg = Configuration()
+    cfg.set(AotOptions.ENABLED, True)
+    cfg.set(AotOptions.DIR, str(directory))
+    if cap:
+        cfg.set(AotOptions.IN_MEMORY_MAX_PROGRAMS, cap)
+    if faults_spec:
+        cfg.set(FaultOptions.ENABLED, True)
+        cfg.set(FaultOptions.SEED, seed)
+        cfg.set(FaultOptions.SPEC, faults_spec)
+    return cfg
+
+
+def _arm(cfg: Configuration) -> None:
+    """Adopt config like the deploy paths do: faults + watchdog + AOT."""
+    faults_mod.FAULTS.configure(cfg)
+    WATCHDOG.configure(cfg)
+    AOT.configure(cfg)
+
+
+def _builder(scope: str):
+    """One instrumented program builder; distinct scope per test keeps the
+    per-scope counters readable."""
+
+    @instrumented_program_cache(scope)
+    def build(mult):
+        @jax.jit
+        def prog(x):
+            return x * mult + jnp.arange(x.shape[0], dtype=x.dtype)
+        return prog
+
+    return build
+
+
+def _fresh_process(cfg: Configuration, *builders) -> int:
+    """Simulate a process restart: drop every in-memory program, then
+    configure + warm exactly like a cold deploy does."""
+    AOT.reset()
+    faults_mod.FAULTS.reset()
+    for b in builders:
+        b.cache_clear()
+    _arm(cfg)
+    return AOT.warmup()
+
+
+def _artifacts(directory) -> list:
+    return sorted(f for f in os.listdir(directory) if f.endswith(".aotx"))
+
+
+X = jnp.arange(64, dtype=jnp.int64)
+
+
+# -- roundtrip --------------------------------------------------------------
+
+def test_cold_run_populates_warm_run_never_compiles(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_rt")
+    cfg = _cfg(d)
+    _arm(cfg)
+    assert AOT.warmup() == 0            # empty cache: nothing to load
+    before = DEVICE_STATS.snapshot()
+    out_cold = np.asarray(build(3)(X))
+    mid = DEVICE_STATS.snapshot()
+    # the cold populate run IS a compile storm: every live compile while
+    # the persistent cache is active is counted
+    assert mid["compiles"] - before["compiles"] == 1
+    assert (mid["compile_storms_total"]
+            - before["compile_storms_total"]) == 1
+    assert mid["aot_stores_total"] - before["aot_stores_total"] == 1
+    assert len(_artifacts(d)) == 1
+
+    assert _fresh_process(cfg, build) == 1
+    out_warm = np.asarray(build(3)(X))
+    after = DEVICE_STATS.snapshot()
+    np.testing.assert_array_equal(out_warm, out_cold)
+    assert after["compiles"] == mid["compiles"]              # recompiles 0
+    assert after["compile_storms_total"] == mid["compile_storms_total"]
+    assert after["aot_hits_total"] - mid["aot_hits_total"] == 1
+    rows = verify_aot_cache(str(d))
+    assert [r[1] for r in rows] == ["OK"]
+
+
+def test_verifier_and_fingerprint_shape(tmp_path):
+    fp = environment_fingerprint()
+    assert fp[0] == AOT_FORMAT and len(fp) == 6
+    # unreadable directory: a CORRUPT row, never an exception
+    rows = verify_aot_cache(str(tmp_path / "nope"))
+    assert rows and rows[0][1] == "CORRUPT"
+
+
+# -- degradation ladder -----------------------------------------------------
+
+@pytest.mark.parametrize("mutate", ["flip", "truncate", "garbage-header"])
+def test_corrupt_artifact_quarantined_and_recompiled(tmp_path, mutate):
+    d = tmp_path / "cache"
+    build = _builder(f"aot_corrupt_{mutate}")
+    cfg = _cfg(d)
+    _arm(cfg)
+    AOT.warmup()
+    out1 = np.asarray(build(7)(X))
+    name = _artifacts(d)[0]
+    path = os.path.join(str(d), name)
+    raw = open(path, "rb").read()
+    if mutate == "flip":
+        bad = bytearray(raw)
+        bad[-10] ^= 0xFF
+    elif mutate == "truncate":
+        bad = raw[: len(raw) // 2]
+    else:
+        bad = b"not json" + raw
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+
+    verify0 = DEVICE_STATS.snapshot()["checkpoint_verify_failures_total"]
+    assert _fresh_process(cfg, build) == 0   # nothing loadable
+    assert not _artifacts(d)                  # quarantined away
+    assert os.path.exists(path + ".corrupt")
+    assert any(e["kind"] == "aot-corrupt-artifact" for e in AOT.events)
+    snap = DEVICE_STATS.snapshot()
+    assert snap["checkpoint_verify_failures_total"] == verify0 + 1
+    compiles0 = snap["compiles"]
+    out2 = np.asarray(build(7)(X))           # degrade: live compile
+    np.testing.assert_array_equal(out2, out1)
+    assert DEVICE_STATS.snapshot()["compiles"] == compiles0 + 1
+    # the fallback compile re-persisted a clean artifact; the quarantined
+    # original sits beside it
+    statuses = sorted(r[1] for r in verify_aot_cache(str(d)))
+    assert statuses == ["OK", "QUARANTINED"]
+
+
+def test_version_skew_is_a_miss_never_an_error(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_skew")
+    cfg = _cfg(d)
+    _arm(cfg)
+    AOT.warmup()
+    out1 = np.asarray(build(5)(X))
+    path = os.path.join(str(d), _artifacts(d)[0])
+    raw = open(path, "rb").read()
+    nl = raw.find(b"\n")
+    header = json.loads(raw[:nl].decode())
+    header["fingerprint"][1] = "0.0.0"       # a different jax vintage
+    with open(path, "wb") as f:
+        f.write(json.dumps(header, sort_keys=True).encode() + raw[nl:])
+
+    assert _fresh_process(cfg, build) == 0
+    assert any(e["kind"] == "aot-version-skew" for e in AOT.events)
+    assert _artifacts(d)                     # NOT quarantined: just skew
+    compiles0 = DEVICE_STATS.snapshot()["compiles"]
+    out2 = np.asarray(build(5)(X))
+    np.testing.assert_array_equal(out2, out1)
+    assert DEVICE_STATS.snapshot()["compiles"] == compiles0 + 1
+
+
+def test_capability_missing_downgrades_with_single_warning(
+        tmp_path, monkeypatch):
+    monkeypatch.setattr("flink_tpu.runtime.aot._serialization_module",
+                        lambda: None)
+    d = tmp_path / "cache"
+    build = _builder("aot_cap")
+    cfg = _cfg(d)
+    _arm(cfg)
+    assert AOT.warmup() == 0
+    AOT.warmup()                             # repeat: still one warning
+    warns = [e for e in AOT.events
+             if e["kind"] == "aot-capability-missing"]
+    assert len(warns) == 1
+    assert not AOT.dispatch_active()
+    compiles0 = DEVICE_STATS.snapshot()["compiles"]
+    out = np.asarray(build(2)(X))            # compile-on-miss still works
+    assert out.shape == (64,)
+    assert DEVICE_STATS.snapshot()["compiles"] == compiles0 + 1
+    assert not _artifacts(d)                 # nothing persisted
+
+
+# -- in-memory LRU ----------------------------------------------------------
+
+def test_lru_eviction_plus_aot_reload_is_never_a_recompile(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_lru")
+    cfg = _cfg(d, cap=1)
+    _arm(cfg)
+    AOT.warmup()
+    out_a = np.asarray(build(2)(X))
+    ev0 = DEVICE_STATS.snapshot()["aot_in_memory_evictions_total"]
+    build(3)(X)                              # cap 1: evicts program A
+    snap = DEVICE_STATS.snapshot()
+    assert snap["aot_in_memory_evictions_total"] == ev0 + 1
+    info = build.cache_info()
+    assert info.maxsize == 1 and info.currsize == 1
+
+    compiles0, hits0 = snap["compiles"], snap["aot_hits_total"]
+    out_a2 = np.asarray(build(2)(X))         # rebuilt after eviction
+    np.testing.assert_array_equal(out_a2, out_a)
+    snap = DEVICE_STATS.snapshot()
+    assert snap["compiles"] == compiles0     # warm reload, NOT a recompile
+    assert snap["aot_hits_total"] == hits0 + 1
+
+
+def test_uncapped_cache_never_evicts(tmp_path):
+    build = _builder("aot_nocap")
+    cfg = _cfg(tmp_path / "cache")           # cap 0 = unbounded
+    _arm(cfg)
+    AOT.warmup()
+    ev0 = DEVICE_STATS.snapshot()["aot_in_memory_evictions_total"]
+    for m in range(2, 7):
+        build(m)(X)
+    assert DEVICE_STATS.snapshot()["aot_in_memory_evictions_total"] == ev0
+    assert build.cache_info().currsize == 5
+
+
+# -- chaos at aot.load / aot.store ------------------------------------------
+
+def test_fault_rules_parse_for_new_sites():
+    assert "aot.load" in FAULT_SITES and "aot.store" in FAULT_SITES
+    r = FaultRule.parse("aot.load=once@2!poison")
+    assert (r.site, r.mode, r.at, r.poison) == ("aot.load", "once", 2, True)
+    r = FaultRule.parse("aot.store=every@3!persistent")
+    assert (r.site, r.mode, r.at, r.transient) == (
+        "aot.store", "every", 3, False)
+
+
+def test_store_trip_skips_persistence_job_keeps_running(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_storetrip")
+    cfg = _cfg(d, faults_spec="aot.store=once@1!persistent")
+    _arm(cfg)
+    AOT.warmup()
+    out = np.asarray(build(4)(X))
+    assert out.shape == (64,)
+    assert not _artifacts(d)                 # store skipped, not failed
+    assert any(e["kind"] == "aot-store-failed" for e in AOT.events)
+
+
+def test_store_poison_commits_corrupt_artifact_load_catches_it(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_storepoison")
+    cfg = _cfg(d, faults_spec="aot.store=once@1!poison")
+    _arm(cfg)
+    AOT.warmup()
+    out1 = np.asarray(build(6)(X))
+    assert len(_artifacts(d)) == 1           # committed — but corrupt
+
+    clean_cfg = _cfg(d)
+    assert _fresh_process(clean_cfg, build) == 0
+    assert any(e["kind"] == "aot-corrupt-artifact" for e in AOT.events)
+    out2 = np.asarray(build(6)(X))           # verified load caught it
+    np.testing.assert_array_equal(out2, out1)
+
+
+def test_load_poison_chaos_drill_falls_back_to_compile(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_loadpoison")
+    _arm(_cfg(d))
+    AOT.warmup()
+    out1 = np.asarray(build(9)(X))
+    assert len(_artifacts(d)) == 1
+
+    cfg = _cfg(d, faults_spec="aot.load=once@1!poison")
+    assert _fresh_process(cfg, build) == 0   # mutated read -> quarantine
+    assert any(e["kind"] == "aot-corrupt-artifact" for e in AOT.events)
+    compiles0 = DEVICE_STATS.snapshot()["compiles"]
+    out2 = np.asarray(build(9)(X))
+    np.testing.assert_array_equal(out2, out1)
+    assert DEVICE_STATS.snapshot()["compiles"] == compiles0 + 1
+
+
+def test_load_transient_trip_is_retried_and_absorbed(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_loadretry")
+    _arm(_cfg(d))
+    AOT.warmup()
+    build(8)(X)
+    cfg = _cfg(d, faults_spec="aot.load=once@1")      # transient
+    assert _fresh_process(cfg, build) == 1            # retry absorbed it
+
+
+def test_load_persistent_fault_degrades_artifact_survives(tmp_path):
+    d = tmp_path / "cache"
+    build = _builder("aot_loadpersist")
+    _arm(_cfg(d))
+    AOT.warmup()
+    build(8)(X)
+    fb0 = DEVICE_STATS.snapshot()["aot_fallbacks_total"]
+    cfg = _cfg(d, faults_spec="aot.load=always!persistent")
+    assert _fresh_process(cfg, build) == 0
+    assert any(e["kind"] == "aot-load-failed" for e in AOT.events)
+    assert DEVICE_STATS.snapshot()["aot_fallbacks_total"] > fb0
+    assert _artifacts(d)                      # intact, NOT quarantined
+    faults_mod.FAULTS.reset()
+    assert AOT.warmup() == 1                  # next scan loads it fine
+
+
+def test_warmup_stall_degrades_to_partial_warmth(tmp_path, monkeypatch):
+    d = tmp_path / "cache"
+    build = _builder("aot_stall")
+    _arm(_cfg(d))
+    AOT.warmup()
+    build(2)(X)
+    cfg = _cfg(d)
+    cfg.set("watchdog.aot-warmup-timeout", 0.05)
+    AOT.reset()
+    build.cache_clear()
+    _arm(cfg)
+    monkeypatch.setattr(AOT, "_warmup_scan",
+                        lambda: time.sleep(0.5) or 0)
+    assert AOT.warmup() == 0                  # deadline hit: kept partial
+    assert AOT.warmed                         # still serves; no retry loop
+    assert any(e["kind"] == "aot-warmup-stalled" for e in AOT.events)
+    monkeypatch.undo()
+    out = np.asarray(build(2)(X))             # job never fails
+    assert out.shape == (64,)
+
+
+# -- call signatures --------------------------------------------------------
+
+def test_call_signature_discriminates_and_guards():
+    s1 = AOT.call_signature((jnp.zeros((4,), jnp.int64),), {})
+    s2 = AOT.call_signature((jnp.zeros((8,), jnp.int64),), {})
+    s3 = AOT.call_signature((jnp.zeros((4,), jnp.int64),), {})
+    assert s1 != s2 and s1 == s3
+    assert AOT.call_signature((jnp.zeros(3), 7, "flag"), {}) is not None
+    assert AOT.call_signature((object(),), {}) is None   # not AOT-able
+
+
+# -- HA journal pointer + successor warm start ------------------------------
+
+def test_ha_services_record_aot_dir_next_to_checkpoint_pointer(tmp_path):
+    from flink_tpu.cluster.ha import FileHaServices, HaJobSupervisor
+    ha = FileHaServices(str(tmp_path / "ha"))
+    assert ha.get_aot_dir("job") == ""
+    ha.put_aot_dir("job", "/shared/aot")
+    assert ha.get_aot_dir("job") == "/shared/aot"
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "ha"), "checkpoints", "job.aot.json"))
+
+    cfg = Configuration()
+    cfg.set(AotOptions.DIR, str(tmp_path / "cache"))
+    sup = HaJobSupervisor(ha, "subjob", cfg)
+    sup.submit({"graph": "stub"})
+    assert ha.get_aot_dir("subjob") == str(tmp_path / "cache")
+
+
+def test_coordinator_journal_carries_aot_dir(tmp_path):
+    from flink_tpu.cluster.distributed import _Coordinator
+    cfg = _cfg(tmp_path / "cache")
+    coord = _Coordinator(1, cfg, port=0)
+    try:
+        journal = coord._journal_locked()
+        assert journal["aot_dir"] == str(tmp_path / "cache")
+    finally:
+        coord.close()
+
+
+def test_successor_warms_from_journaled_dir_zero_compiles(tmp_path):
+    """The failover x warm-start drill at the component level: a
+    predecessor populates the shared cache and journals its location; the
+    successor (a simulated fresh process) adopts the journal, warms, and
+    serves the same program with ZERO live compiles and byte-identical
+    output."""
+    from flink_tpu.cluster.ha import FileHaServices
+    d = tmp_path / "shared-aot"
+    build = _builder("aot_takeover")
+    _arm(_cfg(d))
+    AOT.warmup()
+    out1 = np.asarray(build(11)(X))
+    ha = FileHaServices(str(tmp_path / "ha"))
+    ha.put_aot_dir("job", str(d))
+
+    # successor: a config WITHOUT aot.dir — the journal supplies it (the
+    # HaJobSupervisor.run adoption path)
+    AOT.reset()
+    build.cache_clear()
+    cfg = Configuration()
+    jdir = ha.get_aot_dir("job")
+    assert jdir
+    cfg.set(AotOptions.ENABLED, True)
+    cfg.set(AotOptions.DIR, jdir)
+    _arm(cfg)
+    assert AOT.warmup() == 1
+    snap0 = DEVICE_STATS.snapshot()
+    out2 = np.asarray(build(11)(X))
+    snap = DEVICE_STATS.snapshot()
+    np.testing.assert_array_equal(out2, out1)
+    assert snap["compiles"] == snap0["compiles"]
+    assert snap["compile_storms_total"] == snap0["compile_storms_total"]
+    assert snap["aot_hits_total"] == snap0["aot_hits_total"] + 1
+
+
+# -- end-to-end local job ---------------------------------------------------
+
+def _clear_device_program_caches() -> None:
+    """Cold-process simulation for the e2e drill: drop every module-level
+    instrumented program cache the device-window pipeline uses."""
+    import flink_tpu.runtime.operators.device_window as dw
+    import flink_tpu.state.tpu_backend as tb
+    for mod in (dw, tb):
+        for name in dir(mod):
+            fn = getattr(mod, name)
+            if callable(fn) and hasattr(fn, "cache_clear"):
+                fn.cache_clear()
+
+
+def _run_e2e_job(aot_dir) -> dict:
+    from flink_tpu.api import StreamExecutionEnvironment
+    from flink_tpu.core import WatermarkStrategy
+    from flink_tpu.core.functions import SinkFunction
+    from flink_tpu.core.records import Schema
+    from flink_tpu.runtime.operators.device_window import AggSpec
+    from flink_tpu.window import TumblingEventTimeWindows
+
+    schema = Schema([("k", np.int64), ("v", np.int64), ("ts", np.int64)])
+    n = 4000
+
+    def gen(idx):
+        u = idx.astype(np.uint64)
+        k = ((u * np.uint64(0x9E3779B97F4A7C15)) % np.uint64(31)).astype(
+            np.int64)
+        return {"k": k, "v": (idx % 13) + 1, "ts": (idx * 8000) // n}
+
+    class _Collect(SinkFunction):
+        def __init__(self):
+            self.totals = {}
+
+        def invoke_batch(self, batch):
+            for k, w, c, s in zip(batch.column("k"),
+                                  batch.column("window_end"),
+                                  batch.column("bids"),
+                                  batch.column("vol")):
+                self.totals[(int(k), int(w))] = (int(c), int(s))
+            return True
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_state_backend("tpu")
+    env.config.set(PipelineOptions.BATCH_SIZE, 1024)
+    env.config.set(AotOptions.ENABLED, True)
+    env.config.set(AotOptions.DIR, str(aot_dir))
+    ws = WatermarkStrategy.for_monotonous_timestamps() \
+        .with_timestamp_column("ts")
+    sink = _Collect()
+    (env.datagen(gen, schema, count=n, timestamp_column="ts",
+                 watermark_strategy=ws, device=True)
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(2000))
+        .device_aggregate([AggSpec("count", out_name="bids"),
+                           AggSpec("sum", "v", out_name="vol")],
+                          capacity=1 << 10, ring_size=8)
+        .add_sink(sink, "collect"))
+    env.execute("aot-e2e", timeout=300.0)
+    return sink.totals
+
+
+def test_e2e_local_job_warm_restart_zero_recompiles(tmp_path):
+    """deploy_local wires configure + warmup; a cold-process rerun against
+    the populated cache fires identical windows with zero compiles and
+    zero compile storms — the acceptance drill, in-process."""
+    d = tmp_path / "cache"
+    totals_cold = _run_e2e_job(d)
+    assert totals_cold
+    snap_cold = DEVICE_STATS.snapshot()
+    assert snap_cold["aot_stores_total"] > 0
+    assert _artifacts(d)
+    assert AOT.snapshot()["enabled"] and AOT.snapshot()["warmed"]
+    # the cold-start clock ran: AOT-enabled configure to first d2h
+    assert snap_cold["cold_start_ms_count"] >= 1
+
+    AOT.reset()
+    _clear_device_program_caches()
+    totals_warm = _run_e2e_job(d)
+    snap_warm = DEVICE_STATS.snapshot()
+    assert totals_warm == totals_cold        # byte-identical windows
+    assert snap_warm["compiles"] == snap_cold["compiles"]
+    assert (snap_warm["compile_storms_total"]
+            == snap_cold["compile_storms_total"])
+    assert snap_warm["aot_hits_total"] > snap_cold["aot_hits_total"]
+
+
+# -- REST + CLI surfaces ----------------------------------------------------
+
+def test_rest_exceptions_surface_aot_degradations(tmp_path):
+    import urllib.request
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+    from flink_tpu.cluster.rest import RestEndpoint
+    from flink_tpu.connectors.core import CollectSink
+    from flink_tpu.core.records import Schema
+
+    AOT._event("aot-corrupt-artifact", artifact="deadbeef.aotx",
+               error="payload digest mismatch")
+    schema = Schema([("k", np.int64), ("v", np.int64)])
+    env = StreamExecutionEnvironment()
+    env.config.set(PipelineOptions.BATCH_SIZE, 8)
+    rows = [(i % 3, i) for i in range(64)]
+    ds = env.from_collection(rows, schema, timestamps=list(range(64)))
+    ds.key_by("k").sum(1).add_sink(CollectSink(), "s")
+    job = env.execute_async("aot-rest")
+    coord = CheckpointCoordinator(job, env.config)
+    endpoint = RestEndpoint(port=0)
+    endpoint.register_job("aot-rest", job, coord)
+    port = endpoint.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/jobs/aot-rest/exceptions",
+                timeout=10) as r:
+            body = json.loads(r.read().decode())
+        kinds = {e.get("kind") for e in body["entries"]}
+        assert "aot-corrupt-artifact" in kinds
+    finally:
+        endpoint.stop()
+        job.wait(60)
+
+
+def test_cli_aot_cache_verifier(tmp_path, capsys):
+    from flink_tpu.cli import main as cli_main
+
+    d = tmp_path / "cache"
+    build = _builder("aot_cli")
+    _arm(_cfg(d))
+    AOT.warmup()
+    build(3)(X)
+    build(4)(X)
+    names = _artifacts(d)
+    assert len(names) == 2
+    assert cli_main(["aot-cache", str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out and names[0] in out
+
+    # corrupt one -> exit 1 and a CORRUPT row
+    path = os.path.join(str(d), names[0])
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[:-4])
+    assert cli_main(["aot-cache", str(d)]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+
+    # empty / missing dir -> exit 2
+    assert cli_main(["aot-cache", str(tmp_path / "empty-missing")]) == 2
+
+
+def test_checkpoint_verify_sweeps_colocated_aot_subdir(tmp_path, capsys):
+    from flink_tpu.cli import main as cli_main
+
+    root = tmp_path / "ckpt"
+    d = root / "aot"
+    build = _builder("aot_cli_sweep")
+    _arm(_cfg(d))
+    AOT.warmup()
+    build(3)(X)
+    assert cli_main(["checkpoint-verify", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "aot/" in out and "OK" in out

@@ -430,7 +430,11 @@ def test_hot_takeover_no_restart_no_recompile(tmp_path):
         elections0 = snap0["leader_elections_total"]
         tk_count0 = snap0["takeover_duration_ms_count"]
         compiles_at_kill = DEVICE_STATS.compiles
-        dumps0 = len(FLIGHT_RECORDER.dumps)
+        # FLIGHT_RECORDER.dumps is a bounded list (KEEP_DUMPS): appends
+        # past the cap trim the head, so an index captured here can slice
+        # a later record away. Filter by timestamp instead.
+        from flink_tpu.metrics.tracing import now_ms
+        dump_ts0 = now_ms()
 
         leader.kill()  # SIGKILL analog: lease NOT released, sockets drop
 
@@ -454,8 +458,9 @@ def test_hot_takeover_no_restart_no_recompile(tmp_path):
         assert snap["leader_elections_total"] >= elections0 + 1
         assert snap["takeover_duration_ms_count"] >= tk_count0 + 1
         assert snap["takeover_duration_ms_max"] > 0.0
-        failover_dumps = [d for d in FLIGHT_RECORDER.dumps[dumps0:]
-                          if d["reason"] == "failover"]
+        failover_dumps = [d for d in FLIGHT_RECORDER.dumps
+                          if d["reason"] == "failover"
+                          and d["ts_ms"] >= dump_ts0]
         assert failover_dumps, "takeover produced no flight-recorder dump"
         assert failover_dumps[-1]["mode"] == "hot"
         assert os.path.basename(failover_dumps[-1]["path"]).startswith(
